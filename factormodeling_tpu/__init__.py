@@ -1,0 +1,27 @@
+"""factormodeling-tpu: a TPU-native quantitative factor-modeling framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of the reference
+``Yuming-Yang/FactorModeling`` library (pandas panel transforms, IC/ICIR factor
+scoring, rolling factor selection, composite-factor blending, and dollar-neutral
+long/short backtesting with MVO weight optimization), re-designed around dense
+``(factors, dates, assets)`` arrays, vmapped cross-sectional kernels, cumsum
+rolling aggregation, and a batched fixed-iteration ADMM QP solver.
+
+Layer map (mirrors SURVEY.md section 1, built TPU-first):
+
+- :mod:`factormodeling_tpu.panel`       L1 data model: dense masked panels
+- :mod:`factormodeling_tpu.ops`         L2 ops library (reference operations.py)
+- :mod:`factormodeling_tpu.metrics`     L3 factor scoring (factor_selector.py)
+- :mod:`factormodeling_tpu.selection`   L3 rolling selection + method registry
+- :mod:`factormodeling_tpu.composite`   L3 composite blending (composite_factor.py)
+- :mod:`factormodeling_tpu.solvers`     batched QP (replaces cvxpy/OSQP + SLSQP)
+- :mod:`factormodeling_tpu.backtest`    L4 simulation engine (portfolio_simulation.py)
+- :mod:`factormodeling_tpu.analytics`   L0 analytics (portfolio_analyzer.py)
+- :mod:`factormodeling_tpu.multimanager` L5 manager-of-managers (multi_manager.py)
+- :mod:`factormodeling_tpu.parallel`    mesh sharding / sweep harness
+- :mod:`factormodeling_tpu.compat`      pandas-facing API matching the reference
+"""
+
+__version__ = "0.1.0"
+
+from factormodeling_tpu.panel import Panel, FactorPanel  # noqa: F401

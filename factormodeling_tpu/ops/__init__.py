@@ -1,0 +1,47 @@
+"""Ops library (L2): the reference ``operations.py`` surface as dense masked
+JAX kernels over ``float[..., D, N]`` panels (date axis -2, asset axis -1).
+
+All 28 reference transforms are covered:
+
+- time-series (per symbol, rolling):  :mod:`.timeseries`
+- cross-sectional (per date):         :mod:`.cross_sectional`
+- elementwise math:                   :mod:`.elementwise`
+- group (per date x group):           :mod:`.group`
+- regression (rolling + per-date):    :mod:`.regression`
+"""
+
+from factormodeling_tpu.ops.cross_sectional import (  # noqa: F401
+    cs_bool,
+    cs_filter_center,
+    cs_mean,
+    cs_rank,
+    cs_winsor,
+    cs_zscore,
+    market_neutralize,
+)
+from factormodeling_tpu.ops.elementwise import abs_, clip, log, power, sign  # noqa: F401
+from factormodeling_tpu.ops.group import (  # noqa: F401
+    bucket,
+    group_mean,
+    group_neutralize,
+    group_normalize,
+    group_rank_normalized,
+)
+from factormodeling_tpu.ops.regression import cs_regression, ts_regression_fast  # noqa: F401
+from factormodeling_tpu.ops.timeseries import (  # noqa: F401
+    ts_backfill,
+    ts_decay,
+    ts_delay,
+    ts_diff,
+    ts_mean,
+    ts_rank,
+    ts_std,
+    ts_sum,
+    ts_zscore,
+)
+from factormodeling_tpu.ops._window import (  # noqa: F401
+    forward_fill,
+    masked_shift,
+    rolling_sum,
+    shift,
+)

@@ -1,0 +1,156 @@
+"""Masked ranking and quantile primitives.
+
+pandas cross-sectional semantics the reference relies on (``operations.py``):
+average-tie ranks over the non-NaN subset, linear-interpolation quantiles, and
+group-scoped variants. The TPU formulation is sort-based: one multi-key
+``lax.sort`` per kernel (validity flag first, so NaN padding can never collide
+with genuine values), tie runs resolved with cummax/cummin over run-start
+indicators, results scattered back through the inverse permutation. Everything
+batches over leading dims without vmap because ``lax.sort`` sorts one chosen
+dimension elementwise.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["avg_rank", "masked_quantile", "segment_avg_rank"]
+
+
+def _run_starts_to_last(is_start: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Given run-start flags along ``axis``, the index of the last element of
+    each element's run."""
+    n = is_start.shape[axis]
+    shape = [1] * is_start.ndim
+    shape[axis] = n
+    ar = jnp.broadcast_to(jnp.arange(n).reshape(shape), is_start.shape)
+    nxt_start = jnp.concatenate(
+        [lax.slice_in_dim(is_start, 1, n, axis=axis),
+         jnp.ones_like(lax.slice_in_dim(is_start, 0, 1, axis=axis))], axis=axis)
+    end_pos = jnp.where(nxt_start, ar, n)
+    return jnp.flip(lax.cummin(jnp.flip(end_pos, axis=axis), axis=axis), axis=axis)
+
+
+def _run_starts_to_first(is_start: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Given run-start flags along ``axis``, the index of the first element of
+    each element's run."""
+    n = is_start.shape[axis]
+    shape = [1] * is_start.ndim
+    shape[axis] = n
+    ar = jnp.broadcast_to(jnp.arange(n).reshape(shape), is_start.shape)
+    start_pos = jnp.where(is_start, ar, -1)
+    return lax.cummax(start_pos, axis=axis)
+
+
+def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -1):
+    """Average-tie 1-based rank of each value among the valid values of its
+    segment, plus the valid count of that segment.
+
+    ``seg_ids`` are int segment labels (any values; < 0 = not in any segment).
+    NaN values and negative segments get rank NaN; counts are still reported
+    for NaN cells that carry a segment id (the reference's
+    ``group_rank_normalized`` needs the count to decide its ``<=1 valid -> 0.5``
+    rule for NaN rows too, ``operations.py:158-160``).
+
+    With ``seg_ids == 0`` everywhere this is a full cross-sectional rank.
+    """
+    axis = axis % values.ndim
+    n = values.shape[axis]
+    shape = [1] * values.ndim
+    shape[axis] = n
+    ar = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32).reshape(shape), values.shape)
+
+    seg_ids = jnp.broadcast_to(seg_ids, values.shape).astype(jnp.int32)
+    valid = ~jnp.isnan(values) & (seg_ids >= 0)
+    invalid_key = (~valid).astype(jnp.int32)
+    vals_key = jnp.where(valid, values, 0.0)
+
+    s_invalid, s_seg, s_val, s_idx = lax.sort(
+        (invalid_key, seg_ids, vals_key, ar), dimension=axis, num_keys=3, is_stable=True)
+
+    def shift_one(a):
+        return jnp.concatenate(
+            [lax.slice_in_dim(a, 0, 1, axis=axis),
+             lax.slice_in_dim(a, 0, n - 1, axis=axis)], axis=axis)
+    first_col = jnp.concatenate(
+        [jnp.ones_like(lax.slice_in_dim(s_seg, 0, 1, axis=axis), dtype=bool),
+         jnp.zeros_like(lax.slice_in_dim(s_seg, 0, n - 1, axis=axis), dtype=bool)],
+        axis=axis)
+    seg_start = first_col | (s_seg != shift_one(s_seg)) | (s_invalid != shift_one(s_invalid))
+    tie_start = seg_start | (s_val != shift_one(s_val))
+
+    pos = jnp.broadcast_to(jnp.arange(n).reshape(shape), values.shape)
+    seg_first = _run_starts_to_first(seg_start, axis)
+    seg_last = _run_starts_to_last(seg_start, axis)
+    tie_first = _run_starts_to_first(tie_start, axis)
+    tie_last = _run_starts_to_last(tie_start, axis)
+
+    avg_rank_sorted = 0.5 * ((tie_first - seg_first + 1) + (tie_last - seg_first + 1))
+    count_sorted = (seg_last - seg_first + 1).astype(values.dtype)
+    rank_ok = s_invalid == 0
+    avg_rank_sorted = jnp.where(rank_ok, avg_rank_sorted, jnp.nan)
+
+    inv = jnp.argsort(s_idx, axis=axis)
+    ranks = jnp.take_along_axis(avg_rank_sorted, inv, axis=axis)
+
+    # valid count per segment id, gathered for every cell carrying that id
+    # (including NaN cells) via a second pass keyed on seg alone.
+    seg_for_count = jnp.where(seg_ids >= 0, seg_ids, jnp.iinfo(jnp.int32).max)
+    c_seg, c_valid, c_idx = lax.sort(
+        (seg_for_count, valid.astype(jnp.int32), ar), dimension=axis, num_keys=1,
+        is_stable=True)
+    cstart = first_col | (c_seg != shift_one(c_seg))
+    cfirst = _run_starts_to_first(cstart, axis)
+    csum = jnp.cumsum(c_valid, axis=axis)
+    base = jnp.take_along_axis(csum, cfirst, axis=axis) - jnp.take_along_axis(
+        c_valid, cfirst, axis=axis)
+    clast = _run_starts_to_last(cstart, axis)
+    total = jnp.take_along_axis(csum, clast, axis=axis) - base
+    cinv = jnp.argsort(c_idx, axis=axis)
+    counts = jnp.take_along_axis(total, cinv, axis=axis)
+    counts = jnp.where(seg_ids >= 0, counts, 0)
+
+    return ranks, counts
+
+
+def avg_rank(values: jnp.ndarray, *, axis: int = -1) -> jnp.ndarray:
+    """Average-tie 1-based rank among non-NaN values along ``axis`` (NaN -> NaN),
+    i.e. ``scipy.stats.rankdata`` / pandas ``rank(method='average')``."""
+    zeros = jnp.zeros(values.shape, dtype=jnp.int32)
+    ranks, _ = segment_avg_rank(values, zeros, axis=axis)
+    return ranks
+
+
+def masked_quantile(values: jnp.ndarray, qs, *, axis: int = -1) -> jnp.ndarray:
+    """Linear-interpolation quantiles of the non-NaN values along ``axis``
+    (pandas ``Series.quantile`` / ``np.nanpercentile`` rule).
+
+    ``qs``: scalar or 1-D array of K quantiles in [0, 1]. Returns an array with
+    ``axis`` replaced by K (scalar ``qs`` keeps a size-1 axis squeezed away).
+    No valid values -> NaN.
+    """
+    axis = axis % values.ndim
+    n = values.shape[axis]
+    qs_arr = jnp.atleast_1d(jnp.asarray(qs, dtype=values.dtype))
+    valid = ~jnp.isnan(values)
+    cnt = valid.sum(axis=axis, keepdims=True).astype(values.dtype)
+    filled = jnp.where(valid, values, jnp.inf)
+    s = jnp.sort(filled, axis=axis)
+
+    # broadcast: target position per quantile, shape [..., K] on `axis`
+    qshape = [1] * values.ndim
+    qshape[axis] = qs_arr.shape[0]
+    q = qs_arr.reshape(qshape)
+    pos = q * (cnt - 1.0)
+    lo = jnp.clip(jnp.floor(pos), 0, n - 1).astype(jnp.int32)
+    hi = jnp.clip(lo + 1, 0, n - 1)
+    hi = jnp.minimum(hi, jnp.maximum(cnt.astype(jnp.int32) - 1, 0))
+    frac = pos - lo.astype(values.dtype)
+    v_lo = jnp.take_along_axis(s, lo, axis=axis)
+    v_hi = jnp.take_along_axis(s, hi, axis=axis)
+    out = v_lo + (v_hi - v_lo) * frac
+    out = jnp.where(cnt > 0, out, jnp.nan)
+    if jnp.ndim(qs) == 0:
+        out = jnp.squeeze(out, axis=axis)
+    return out

@@ -1,0 +1,120 @@
+"""Rolling-window and shift primitives over the date axis.
+
+Every time-series op in the reference (``operations.py:6-51``) is a pandas
+``rolling(window)`` per symbol with ``min_periods == window`` semantics: the
+result at date ``t`` is defined only when all ``window`` trailing observations
+are non-NaN (pandas counts non-NaN toward ``min_periods``; with
+``min_periods == window`` a single NaN in the window invalidates the cell).
+
+TPU design: a window sum is one ``lax.reduce_window`` over the date axis —
+each output is an independent window reduction (no long-range cumsum
+cancellation), XLA lowers it efficiently, and the same primitive serves
+counts (mask sums), second moments, and covariances. Ragged-universe shifts
+and compaction are sort-based (a stable argsort is an O(D log D) TPU-friendly
+way to "drop missing rows" without dynamic shapes). Date axis is -2, asset
+axis -1, arbitrary leading batch dims.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "rolling_sum",
+    "rolling_count",
+    "rolling_valid",
+    "shift",
+    "compaction_order",
+    "masked_shift",
+    "forward_fill",
+]
+
+_DATE_AXIS = -2
+
+
+def rolling_sum(x: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp.ndarray:
+    """Trailing-window sum: out[t] = sum(x[t-window+1 : t+1]) (zero-padded edge)."""
+    axis = axis % x.ndim
+    dims = [1] * x.ndim
+    dims[axis] = window
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (window - 1, 0)
+    return lax.reduce_window(x, jnp.zeros((), x.dtype), lax.add, tuple(dims),
+                             (1,) * x.ndim, tuple(pads))
+
+
+def rolling_count(valid: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp.ndarray:
+    """Trailing-window count of True cells."""
+    return rolling_sum(valid.astype(jnp.int32), window, axis=axis)
+
+
+def rolling_valid(x: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp.ndarray:
+    """Mask of cells where the full trailing window is observed (no NaN)."""
+    return rolling_count(~jnp.isnan(x), window, axis=axis) == window
+
+
+def shift(x: jnp.ndarray, periods: int = 1, *, axis: int = _DATE_AXIS,
+          fill_value=jnp.nan) -> jnp.ndarray:
+    """pandas ``shift(periods)`` along ``axis`` (positive = toward later dates)."""
+    if periods == 0:
+        return x
+    axis = axis % x.ndim
+    d = x.shape[axis]
+    k = abs(periods)
+    if k >= d:
+        return jnp.full_like(x, fill_value)
+    fill_shape = list(x.shape)
+    fill_shape[axis] = k
+    fill = jnp.full(fill_shape, fill_value, dtype=x.dtype)
+    if periods > 0:
+        kept = lax.slice_in_dim(x, 0, d - k, axis=axis)
+        return jnp.concatenate([fill, kept], axis=axis)
+    kept = lax.slice_in_dim(x, k, d, axis=axis)
+    return jnp.concatenate([kept, fill], axis=axis)
+
+
+def compaction_order(present: jnp.ndarray, *, axis: int = _DATE_AXIS):
+    """Stable order that moves present cells to the front of ``axis`` in date
+    order, plus its inverse. ``take_along_axis(x, order)`` is the dense analog
+    of pandas dropping a symbol's missing dates before a rolling op."""
+    axis = axis % present.ndim
+    d = present.shape[axis]
+    shape = [1] * present.ndim
+    shape[axis] = d
+    ar = jnp.arange(d).reshape(shape)
+    key = jnp.where(present, ar, ar + d)
+    order = jnp.argsort(key, axis=axis)
+    inv = jnp.argsort(order, axis=axis)
+    return order, inv
+
+
+def masked_shift(x: jnp.ndarray, present: jnp.ndarray, periods: int = 1,
+                 *, axis: int = _DATE_AXIS) -> jnp.ndarray:
+    """``groupby(symbol).shift(periods)`` on a ragged universe.
+
+    pandas shifts within each symbol's own (possibly gappy) date sequence
+    (e.g. the weight lag at reference ``portfolio_simulation.py:152``); when a
+    symbol is absent on some dates its value hops over the gap. ``present``
+    marks membership; absent cells come out NaN.
+    """
+    present = jnp.broadcast_to(present, x.shape)
+    order, inv = compaction_order(present, axis=axis)
+    compact = jnp.take_along_axis(x, order, axis=axis)
+    moved = shift(compact, periods, axis=axis)
+    out = jnp.take_along_axis(moved, inv, axis=axis)
+    return jnp.where(present, out, jnp.nan)
+
+
+def forward_fill(x: jnp.ndarray, *, axis: int = _DATE_AXIS) -> jnp.ndarray:
+    """Per-column forward fill (reference ``ts_backfill``, ``operations.py:50`` —
+    despite its name it is an ffill)."""
+    axis = axis % x.ndim
+    d = x.shape[axis]
+    shape = [1] * x.ndim
+    shape[axis] = d
+    ar = jnp.broadcast_to(jnp.arange(d).reshape(shape), x.shape)
+    idx = jnp.where(jnp.isnan(x), -1, ar)
+    last = lax.cummax(idx, axis=axis)
+    filled = jnp.take_along_axis(x, jnp.clip(last, 0, d - 1), axis=axis)
+    return jnp.where(last >= 0, filled, jnp.nan)

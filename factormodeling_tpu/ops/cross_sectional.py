@@ -1,0 +1,137 @@
+"""Cross-sectional ops: per-date transforms over the asset axis.
+
+Reference surface: ``operations.py:54-101,171-182`` (cs_rank/winsor/
+filter_center/zscore/bool/mean, market_neutralize, elementwise math). Each
+pandas ``groupby(level='date')`` becomes a masked reduction along the asset
+axis (-1); all dates (and any leading factor dims) process in one fused XLA
+kernel.
+
+Universe semantics: ``universe`` marks which cells exist in the originating
+long index. The reference's NaN quirks depend on it — e.g. ``cs_rank``'s
+normalizing denominator counts NaN-valued rows (``operations.py:58-60``), and
+single-row dates get 0.5. ``universe=None`` means every column exists.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from factormodeling_tpu.ops._rank import avg_rank, masked_quantile
+
+__all__ = [
+    "cs_rank",
+    "cs_winsor",
+    "cs_filter_center",
+    "cs_zscore",
+    "cs_bool",
+    "cs_mean",
+    "market_neutralize",
+]
+
+_ASSET_AXIS = -1
+
+
+def _universe_count(x, universe):
+    if universe is None:
+        return jnp.full(x.shape[:-1] + (1,), x.shape[-1], dtype=x.dtype)
+    return jnp.sum(jnp.broadcast_to(universe, x.shape),
+                   axis=_ASSET_AXIS, keepdims=True).astype(x.dtype)
+
+
+def _masked_moments(x, *, ddof: int):
+    valid = ~jnp.isnan(x)
+    cnt = valid.sum(axis=_ASSET_AXIS, keepdims=True).astype(x.dtype)
+    s = jnp.where(valid, x, 0.0).sum(axis=_ASSET_AXIS, keepdims=True)
+    mean = s / cnt
+    dev = jnp.where(valid, x - mean, 0.0)
+    var = (dev * dev).sum(axis=_ASSET_AXIS, keepdims=True) / jnp.maximum(cnt - ddof, 0.0)
+    return mean, jnp.sqrt(var), cnt
+
+
+def _mask_input(x, universe):
+    """Out-of-universe cells must not contaminate cross-sectional stats even
+    when they hold non-NaN values (e.g. after a forward fill)."""
+    if universe is None:
+        return x
+    return jnp.where(universe, x, jnp.nan)
+
+
+def cs_rank(x: jnp.ndarray, universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-date rank normalized to [0, 1]: ``(rank - 1) / (n - 1)`` with
+    average ties, where ``n`` is the full group size *including NaN rows*
+    (reference quirk, ``operations.py:58-60``); single-row dates -> 0.5."""
+    x = _mask_input(x, universe)
+    r = avg_rank(x, axis=_ASSET_AXIS)
+    n = _universe_count(x, universe)
+    out = (r - 1.0) / (n - 1.0)
+    out = jnp.where(n == 1, 0.5, out)
+    if universe is not None:
+        out = jnp.where(universe, out, jnp.nan)
+    return out
+
+
+def cs_winsor(x: jnp.ndarray, limits=(0.01, 0.99), min_valid: int = 5,
+              universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Clip to per-date [q_low, q_high] quantiles; dates with fewer than
+    ``min_valid`` non-NaN rows pass through (reference ``operations.py:64-68``)."""
+    x = _mask_input(x, universe)
+    qs = masked_quantile(x, jnp.asarray(limits, dtype=x.dtype), axis=_ASSET_AXIS)
+    lo = jnp.expand_dims(qs[..., 0], _ASSET_AXIS)
+    hi = jnp.expand_dims(qs[..., 1], _ASSET_AXIS)
+    cnt = (~jnp.isnan(x)).sum(axis=_ASSET_AXIS, keepdims=True)
+    clipped = jnp.clip(x, lo, hi)
+    return jnp.where(cnt >= min_valid, clipped, x)
+
+
+def cs_filter_center(x: jnp.ndarray, center=(0.3, 0.7),
+                     universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Zero out the middle quantile band, keep the tails (reference
+    ``operations.py:70-75``). pandas ``where`` turns NaN rows into 0 too;
+    cells outside the universe stay NaN."""
+    x = _mask_input(x, universe)
+    qs = masked_quantile(x, jnp.asarray(center, dtype=x.dtype), axis=_ASSET_AXIS)
+    lo = jnp.expand_dims(qs[..., 0], _ASSET_AXIS)
+    hi = jnp.expand_dims(qs[..., 1], _ASSET_AXIS)
+    keep = (x < lo) | (x > hi)  # False for NaN -> 0, matching pandas .where
+    out = jnp.where(keep, x, 0.0)
+    if universe is not None:
+        out = jnp.where(universe, out, jnp.nan)
+    return out
+
+
+def cs_zscore(x: jnp.ndarray, universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-date z-score, ddof=0 (reference ``operations.py:77``). A constant
+    date gives 0/0 -> NaN, matching pandas arithmetic."""
+    x = _mask_input(x, universe)
+    mean, std, _ = _masked_moments(x, ddof=0)
+    return (x - mean) / std
+
+
+def cs_bool(cond: jnp.ndarray, true_value, false_value) -> jnp.ndarray:
+    """np.where pass-through (reference ``operations.py:80``)."""
+    return jnp.where(cond, true_value, false_value)
+
+
+def cs_mean(x: jnp.ndarray, universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Broadcast per-date mean of the non-NaN rows to every universe cell
+    (reference ``operations.py:85``; pandas transform broadcasts to NaN rows)."""
+    x = _mask_input(x, universe)
+    mean, _, cnt = _masked_moments(x, ddof=0)
+    out = jnp.broadcast_to(jnp.where(cnt > 0, mean, jnp.nan), x.shape)
+    if universe is not None:
+        out = jnp.where(universe, out, jnp.nan)
+    return out
+
+
+def market_neutralize(x: jnp.ndarray, universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-date z-score ddof=0 with the reference's safe-sigma rule: sigma == 0
+    or undefined -> the whole date becomes 0, NaN rows included (reference
+    ``operations.py:171-182``; despite the name it is a z-score, not a demean)."""
+    x = _mask_input(x, universe)
+    mean, std, cnt = _masked_moments(x, ddof=0)
+    degenerate = (std == 0.0) | jnp.isnan(std) | (cnt == 0)
+    z = (x - mean) / std
+    out = jnp.where(degenerate, 0.0, z)
+    if universe is not None:
+        out = jnp.where(universe, out, jnp.nan)
+    return out

@@ -1,0 +1,27 @@
+"""Elementwise math pass-throughs (reference ``operations.py:88-101``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["sign", "power", "log", "abs_", "clip"]
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def power(x, exp):
+    return jnp.power(x, exp)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def abs_(x):
+    return jnp.abs(x)
+
+
+def clip(x, lower, upper):
+    return jnp.clip(x, lower, upper)

@@ -1,0 +1,119 @@
+"""Group ops: per-(date, group) transforms (industry buckets etc.).
+
+Reference surface: ``operations.py:104-168`` (bucket, group_mean,
+group_neutralize, group_normalize, group_rank_normalized). Groups are dense
+int ids in ``[0, num_groups)`` with ``-1`` meaning "no group" (pandas drops
+NaN group keys, so those rows transform to NaN). The compat layer maps label
+vocabularies to ids.
+
+TPU design: per-(date, group) sums are scatter-adds into a ``[..., G]`` table
+(one fused gather/scatter pair per op, batched over all dates); group ranks
+reuse the multi-key sort machinery from :mod:`._rank`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from factormodeling_tpu.ops._rank import segment_avg_rank
+
+__all__ = [
+    "bucket",
+    "group_mean",
+    "group_neutralize",
+    "group_normalize",
+    "group_rank_normalized",
+]
+
+_ASSET_AXIS = -1
+
+
+def bucket(x: jnp.ndarray, bin_range=(0.2, 1.0, 0.2)) -> jnp.ndarray:
+    """Fixed-bin bucketing into int ids 0..k-1 (-1 = NaN / out of range).
+
+    Mirrors reference ``operations.py:104-110``: ``pd.cut`` with edges
+    ``arange(low, up + 1e-8, step)``, right-closed intervals,
+    ``include_lowest`` (so the first interval also contains its left edge).
+    The reference emits labels "group{i+1}"; the dense kernel emits ``i``.
+    """
+    low, up, step = bin_range
+    edges = np.arange(low, up + 1e-8, step)
+    e = jnp.asarray(edges, dtype=x.dtype)
+    idx = jnp.searchsorted(e, x, side="left").astype(jnp.int32) - 1
+    idx = jnp.where(x == e[0], 0, idx)  # include_lowest
+    bad = jnp.isnan(x) | (x < e[0]) | (x > e[-1])
+    return jnp.where(bad, -1, idx)
+
+
+def _per_row_segment_sums(x: jnp.ndarray, group_ids: jnp.ndarray, num_groups: int):
+    """Per-(row, group) sum / count of non-NaN values, gathered back per cell.
+
+    Rows are everything but the asset axis (so per-date, per-factor-date, ...).
+    Returns (sum_cell, count_cell) broadcast back to ``x.shape``; cells with
+    ``group_ids < 0`` get count 0.
+    """
+    shape = x.shape
+    n = shape[_ASSET_AXIS]
+    xb = x.reshape(-1, n)
+    gb = jnp.broadcast_to(group_ids, shape).reshape(-1, n).astype(jnp.int32)
+    b = xb.shape[0]
+
+    valid = ~jnp.isnan(xb) & (gb >= 0)
+    g_safe = jnp.clip(gb, 0, num_groups - 1)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, n))
+
+    sums = jnp.zeros((b, num_groups), xb.dtype).at[rows, g_safe].add(
+        jnp.where(valid, xb, 0.0))
+    cnts = jnp.zeros((b, num_groups), xb.dtype).at[rows, g_safe].add(
+        valid.astype(xb.dtype))
+
+    sum_cell = sums[rows, g_safe]
+    cnt_cell = cnts[rows, g_safe]
+    in_group = gb >= 0
+    sum_cell = jnp.where(in_group, sum_cell, 0.0)
+    cnt_cell = jnp.where(in_group, cnt_cell, 0.0)
+    return sum_cell.reshape(shape), cnt_cell.reshape(shape), in_group.reshape(shape)
+
+
+def group_mean(x: jnp.ndarray, group_ids: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """Per-(date, group) NaN-skipping mean broadcast to every row of the group
+    — NaN-valued rows included (reference ``operations.py:112-122``). Rows
+    without a group -> NaN."""
+    s, c, in_group = _per_row_segment_sums(x, group_ids, num_groups)
+    mean = s / jnp.where(c > 0, c, jnp.nan)
+    return jnp.where(in_group, mean, jnp.nan)
+
+
+def group_neutralize(x: jnp.ndarray, group_ids: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """x minus its (date, group) mean (reference ``operations.py:124-134``)."""
+    return x - group_mean(x, group_ids, num_groups)
+
+
+def group_normalize(x: jnp.ndarray, group_ids: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """Per-(date, group) z-score ddof=0 with the safe-sigma rule: sigma == 0 or
+    undefined -> 0 for every row of the group (reference
+    ``operations.py:137-149``)."""
+    s, c, in_group = _per_row_segment_sums(x, group_ids, num_groups)
+    c_safe = jnp.where(c > 0, c, jnp.nan)
+    mean = s / c_safe
+    dev2 = (x - mean) ** 2  # NaN rows stay NaN -> skipped by the segment sum
+    s2, _, _ = _per_row_segment_sums(dev2, group_ids, num_groups)
+    sigma = jnp.sqrt(s2 / c_safe)
+    degenerate = (sigma == 0.0) | jnp.isnan(sigma)
+    out = jnp.where(degenerate, 0.0, (x - mean) / sigma)
+    return jnp.where(in_group, out, jnp.nan)
+
+
+def group_rank_normalized(x: jnp.ndarray, group_ids: jnp.ndarray,
+                          num_groups: int) -> jnp.ndarray:
+    """Per-(date, group) [0, 1] rank with average ties, NaNs preserved; groups
+    with <= 1 valid row -> 0.5 for every row of the group, NaN rows included
+    (reference ``operations.py:152-168``)."""
+    del num_groups  # sort-based; no table needed
+    gids = jnp.broadcast_to(group_ids, x.shape).astype(jnp.int32)
+    ranks, counts = segment_avg_rank(x, gids, axis=_ASSET_AXIS)
+    few = counts <= 1
+    out = (ranks - 1.0) / (counts - 1.0)
+    out = jnp.where(few, 0.5, out)
+    return jnp.where(gids >= 0, out, jnp.nan)

@@ -1,0 +1,128 @@
+"""Closed-form OLS ops: rolling per-symbol and per-date cross-sectional.
+
+Reference surface: ``operations.py:185-304`` (``ts_regression_fast``,
+``cs_regression``), both closed-form univariate y ~ x via cov/var moments.
+
+TPU design: ``cs_regression`` is one masked-moment reduction over the asset
+axis for all dates at once. ``ts_regression_fast`` replicates the reference's
+drop-missing-rows-then-roll semantics (it calls ``dropna()`` before the
+per-symbol rolling, so windows span gaps) with a sort-based compaction per
+column — valid cells are permuted to the front in date order, rolled, and
+scattered back, all with static shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from factormodeling_tpu.ops._window import compaction_order, masked_shift, rolling_sum, shift
+
+__all__ = ["ts_regression_fast", "cs_regression", "TS_RETTYPES", "CS_RETTYPES"]
+
+_DATE_AXIS = -2
+_ASSET_AXIS = -1
+
+# reference rettype codes (operations.py:229-240)
+TS_RETTYPES = {0: "resid", 1: "alpha", 2: "beta", 3: "fitted", 6: "r2"}
+CS_RETTYPES = ("resid", "beta", "alpha", "fitted", "r2")
+
+
+def ts_regression_fast(y: jnp.ndarray, x: jnp.ndarray, window: int,
+                       lag: int = 0, rettype: int = 2,
+                       universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-symbol rolling OLS y ~ x over the last ``window`` jointly-valid
+    observations (reference ``operations.py:185-246``).
+
+    ``lag`` shifts x forward ``lag`` dates per symbol (within ``universe`` when
+    given) before pairing. (The reference shifts the *long* frame positionally,
+    which leaks values across symbols within a date — a deliberate fix here,
+    documented divergence.) rettype: 0=resid, 1=alpha, 2=beta, 3=fitted, 6=R^2.
+
+    The dropna-before-rolling semantics mean windows already span any universe
+    gaps (absent cells are NaN -> dropped), so ``universe`` only matters for
+    the lag shift.
+    """
+    if rettype not in TS_RETTYPES:
+        raise ValueError(f"rettype {rettype} not implemented")
+    if universe is not None:
+        x = jnp.where(universe, x, jnp.nan)
+        y = jnp.where(universe, y, jnp.nan)
+    if lag:
+        if universe is not None:
+            x = masked_shift(x, universe, lag, axis=_DATE_AXIS)
+        else:
+            x = shift(x, lag, axis=_DATE_AXIS)
+    pair_valid = ~jnp.isnan(x) & ~jnp.isnan(y)
+    xx = jnp.where(pair_valid, x, jnp.nan)
+    yy = jnp.where(pair_valid, y, jnp.nan)
+
+    order, inv = compaction_order(pair_valid, axis=_DATE_AXIS)
+    xc = jnp.take_along_axis(xx, order, axis=_DATE_AXIS)
+    yc = jnp.take_along_axis(yy, order, axis=_DATE_AXIS)
+    cvalid = jnp.take_along_axis(pair_valid, order, axis=_DATE_AXIS)
+
+    full = rolling_sum(cvalid.astype(jnp.int32), window, axis=_DATE_AXIS) == window
+    x0 = jnp.where(cvalid, xc, 0.0)
+    y0 = jnp.where(cvalid, yc, 0.0)
+    sx = rolling_sum(x0, window, axis=_DATE_AXIS)
+    sy = rolling_sum(y0, window, axis=_DATE_AXIS)
+    sxx = rolling_sum(x0 * x0, window, axis=_DATE_AXIS)
+    sxy = rolling_sum(x0 * y0, window, axis=_DATE_AXIS)
+    syy = rolling_sum(y0 * y0, window, axis=_DATE_AXIS)
+
+    mx, my = sx / window, sy / window
+    cov_xy = sxy / window - mx * my
+    var_x = sxx / window - mx * mx
+    beta = cov_xy / var_x
+    alpha = my - beta * mx
+    if rettype == 0:
+        out = yc - (alpha + beta * xc)
+    elif rettype == 1:
+        out = alpha
+    elif rettype == 2:
+        out = beta
+    elif rettype == 3:
+        out = alpha + beta * xc
+    else:  # 6: R^2 = cov^2 / (var_x var_y)
+        var_y = syy / window - my * my
+        out = (cov_xy * cov_xy) / (var_x * var_y)
+    out = jnp.where(full, out, jnp.nan)
+    return jnp.take_along_axis(out, inv, axis=_DATE_AXIS)
+
+
+def cs_regression(y: jnp.ndarray, x: jnp.ndarray, rettype: str = "resid",
+                  universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-date OLS y ~ x over jointly-valid pairs (reference
+    ``operations.py:248-304``): < 2 valid pairs -> all-NaN date; scalar
+    rettypes (beta/alpha/r2) broadcast to the valid cells only."""
+    if rettype not in CS_RETTYPES:
+        raise ValueError(f"ERROR: rettype={rettype}")
+    if universe is not None:
+        x = jnp.where(universe, x, jnp.nan)
+        y = jnp.where(universe, y, jnp.nan)
+    pair_valid = ~jnp.isnan(x) & ~jnp.isnan(y)
+    cnt = pair_valid.sum(axis=_ASSET_AXIS, keepdims=True).astype(y.dtype)
+    x0 = jnp.where(pair_valid, x, 0.0)
+    y0 = jnp.where(pair_valid, y, 0.0)
+    cs = jnp.where(cnt > 0, cnt, jnp.nan)
+    mx = x0.sum(axis=_ASSET_AXIS, keepdims=True) / cs
+    my = y0.sum(axis=_ASSET_AXIS, keepdims=True) / cs
+    dx = jnp.where(pair_valid, x - mx, 0.0)
+    dy = jnp.where(pair_valid, y - my, 0.0)
+    cov_xy = (dx * dy).sum(axis=_ASSET_AXIS, keepdims=True) / cs
+    var_x = (dx * dx).sum(axis=_ASSET_AXIS, keepdims=True) / cs
+    beta = cov_xy / var_x
+    alpha = my - beta * mx
+    if rettype == "resid":
+        out = y - (alpha + beta * x)
+    elif rettype == "beta":
+        out = jnp.broadcast_to(beta, y.shape)
+    elif rettype == "alpha":
+        out = jnp.broadcast_to(alpha, y.shape)
+    elif rettype == "fitted":
+        out = alpha + beta * x
+    else:  # r2
+        var_y = (dy * dy).sum(axis=_ASSET_AXIS, keepdims=True) / cs
+        out = jnp.broadcast_to((cov_xy * cov_xy) / (var_x * var_y), y.shape)
+    out = jnp.where(pair_valid, out, jnp.nan)
+    return jnp.where(cnt >= 2, out, jnp.nan)

@@ -1,0 +1,176 @@
+"""Time-series ops: per-symbol trailing-window transforms.
+
+Reference surface: ``operations.py:6-51`` (ts_sum/mean/std/zscore/rank/diff/
+delay/decay/backfill), each a pandas ``groupby(symbol).rolling(window)`` with
+``min_periods == window``: a cell is defined only when all ``window`` trailing
+observations of that symbol are non-NaN.
+
+TPU design: arrays are ``float[..., D, N]`` (date axis -2, asset axis -1); a
+"per-symbol rolling op" is a windowed reduction along the date axis applied to
+all N columns at once — ``lax.reduce_window`` for sums/moments, a
+``fori_loop`` of lag-compares for order statistics (ts_rank) and weighted sums
+(ts_decay). No Python loop over symbols or dates survives tracing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+from factormodeling_tpu.ops._window import (
+    compaction_order,
+    forward_fill,
+    rolling_count,
+    rolling_sum,
+    shift,
+)
+
+__all__ = [
+    "ts_sum",
+    "ts_mean",
+    "ts_std",
+    "ts_zscore",
+    "ts_rank",
+    "ts_diff",
+    "ts_delay",
+    "ts_decay",
+    "ts_backfill",
+]
+
+_DATE_AXIS = -2
+
+
+def _over_universe(op):
+    """Give a time-series op pandas ragged-universe semantics.
+
+    pandas rolling ops run on each symbol's own date sequence — a symbol
+    absent on some dates has no row there, so windows and shifts span the gap.
+    On dense arrays that means: compact each column's present cells to the
+    front (stable sort by presence), run the op, scatter back, NaN out absent
+    cells. ``universe=None`` (dense universe) skips the permutation entirely.
+    In-universe NaN values still count as NaN observations, exactly as a
+    NaN-valued pandas row does.
+    """
+
+    @functools.wraps(op)
+    def wrapped(x: jnp.ndarray, *args, universe: jnp.ndarray | None = None, **kwargs):
+        if universe is None:
+            return op(x, *args, **kwargs)
+        present = jnp.broadcast_to(universe, x.shape)
+        order, inv = compaction_order(present, axis=_DATE_AXIS)
+        xc = jnp.take_along_axis(jnp.where(present, x, jnp.nan), order, axis=_DATE_AXIS)
+        out = jnp.take_along_axis(op(xc, *args, **kwargs), inv, axis=_DATE_AXIS)
+        return jnp.where(present, out, jnp.nan)
+
+    return wrapped
+
+
+def _windowed(x: jnp.ndarray, window: int):
+    """(zero-filled values, full-window-valid mask)."""
+    valid = ~jnp.isnan(x)
+    filled = jnp.where(valid, x, 0.0)
+    full = rolling_count(valid, window, axis=_DATE_AXIS) == window
+    return filled, full
+
+
+@_over_universe
+def ts_sum(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Trailing-window sum (reference ``operations.py:6``)."""
+    filled, full = _windowed(x, window)
+    s = rolling_sum(filled, window, axis=_DATE_AXIS)
+    return jnp.where(full, s, jnp.nan)
+
+
+@_over_universe
+def ts_mean(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Trailing-window mean (reference ``operations.py:10``)."""
+    filled, full = _windowed(x, window)
+    s = rolling_sum(filled, window, axis=_DATE_AXIS)
+    return jnp.where(full, s / window, jnp.nan)
+
+
+def _ts_moments(x: jnp.ndarray, window: int):
+    filled, full = _windowed(x, window)
+    s1 = rolling_sum(filled, window, axis=_DATE_AXIS)
+    s2 = rolling_sum(filled * filled, window, axis=_DATE_AXIS)
+    mean = s1 / window
+    # ddof=1 sample variance, clamped at 0 against roundoff
+    var = jnp.maximum(s2 - s1 * mean, 0.0) / (window - 1)
+    return mean, var, full
+
+
+@_over_universe
+def ts_std(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Trailing-window sample std, ddof=1 (reference ``operations.py:14``)."""
+    _, var, full = _ts_moments(x, window)
+    return jnp.where(full, jnp.sqrt(var), jnp.nan)
+
+
+@_over_universe
+def ts_zscore(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """(x - rolling mean) / rolling std, std == 0 -> NaN (reference
+    ``operations.py:18-21``)."""
+    mean, var, full = _ts_moments(x, window)
+    std = jnp.sqrt(var)
+    std = jnp.where(std == 0.0, jnp.nan, std)
+    return jnp.where(full, (x - mean) / std, jnp.nan)
+
+
+@_over_universe
+def ts_rank(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Fractional average-tie rank of the last element within its trailing
+    window (reference ``operations.py:23-32``): pandas
+    ``rolling(w, min_periods=w).apply(lambda s: s.rank(pct=True).iloc[-1])``.
+    """
+    _, full = _windowed(x, window)
+
+    def body(j, carry):
+        less, eq = carry
+        lagged = jnp.roll(x, j, axis=_DATE_AXIS)  # rows < j are wrapped garbage,
+        less = less + (lagged < x)                # masked out by `full` below
+        eq = eq + (lagged == x)
+        return less, eq
+
+    zeros = jnp.zeros(x.shape, dtype=x.dtype)
+    less, eq = lax.fori_loop(0, window, body, (zeros, zeros))
+    pct = (less + 0.5 * (eq + 1.0)) / window
+    return jnp.where(full, pct, jnp.nan)
+
+
+@_over_universe
+def ts_diff(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """x - x.shift(window) per symbol (reference ``operations.py:34``)."""
+    return x - shift(x, window, axis=_DATE_AXIS)
+
+
+@_over_universe
+def ts_delay(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """x.shift(window) per symbol (reference ``operations.py:37``)."""
+    return shift(x, window, axis=_DATE_AXIS)
+
+
+@_over_universe
+def ts_decay(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Linear-decay weighted trailing mean, weights 1..window with the
+    heaviest on the newest observation; ``window < 1`` is the identity
+    (reference ``operations.py:40-48``)."""
+    if window < 1:
+        return x
+    filled, full = _windowed(x, window)
+
+    def body(j, acc):
+        lagged = jnp.roll(filled, j, axis=_DATE_AXIS)
+        return acc + (window - j) * lagged
+
+    acc = lax.fori_loop(0, window, body, jnp.zeros(x.shape, dtype=x.dtype))
+    denom = window * (window + 1) / 2.0
+    return jnp.where(full, acc / denom, jnp.nan)
+
+
+@_over_universe
+def ts_backfill(x: jnp.ndarray) -> jnp.ndarray:
+    """Per-symbol forward-fill (reference ``operations.py:50`` — the name is
+    historical; the reference implementation is an ffill)."""
+    return forward_fill(x, axis=_DATE_AXIS)

@@ -1,0 +1,170 @@
+"""Dense panel data model (L1).
+
+The reference library's data model is an implicit convention: every Series /
+DataFrame is a long-format pandas object indexed by ``(date, symbol)`` and ops
+dispatch on ``groupby(level=...)`` (reference ``operations.py:7,62``). On TPU
+that convention becomes a dense, fixed-shape array pair:
+
+- ``values: float[D, N]`` (or ``float[F, D, N]`` for factor stacks) with ``NaN``
+  marking missing observations, and
+- ``universe: bool[D, N]`` marking which (date, symbol) cells exist in the long
+  index at all (a symbol can be *present* with a NaN value — pandas semantics
+  like ``cs_rank``'s NaN-counting denominator depend on the distinction).
+
+Dates / symbols / factor names live host-side as numpy vocabularies; device
+arrays never carry labels. Ragged daily universes become fixed-N padded rows,
+and every kernel in :mod:`factormodeling_tpu.ops` is masking-aware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Panel", "FactorPanel", "from_long", "panel_to_long"]
+
+
+def _as_np_vocab(x) -> np.ndarray:
+    arr = np.asarray(x)
+    if arr.ndim != 1:
+        raise ValueError(f"vocabulary must be 1-D, got shape {arr.shape}")
+    return arr
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Panel:
+    """A dense (dates x assets) panel of one variable.
+
+    ``values[d, n]`` is the observation for ``dates[d]``, ``symbols[n]``; NaN
+    means missing. ``universe[d, n]`` is True where the (date, symbol) pair
+    exists in the originating long index (NaN-valued cells included).
+    """
+
+    values: jnp.ndarray  # float[D, N]
+    universe: jnp.ndarray  # bool[D, N]
+    dates: np.ndarray = dataclasses.field(metadata=dict(static=True))
+    symbols: np.ndarray = dataclasses.field(metadata=dict(static=True))
+
+    def __post_init__(self):
+        if self.values.ndim != 2:
+            raise ValueError(f"Panel.values must be [D, N], got {self.values.shape}")
+
+    @property
+    def n_dates(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def n_symbols(self) -> int:
+        return self.values.shape[1]
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+    def with_values(self, values: jnp.ndarray) -> "Panel":
+        return dataclasses.replace(self, values=values)
+
+    @staticmethod
+    def dense(values, dates=None, symbols=None, universe=None) -> "Panel":
+        """Build a Panel from a raw array, defaulting to a full universe."""
+        values = jnp.asarray(values)
+        d, n = values.shape
+        if dates is None:
+            dates = np.arange(d)
+        if symbols is None:
+            symbols = np.arange(n)
+        if universe is None:
+            universe = jnp.ones((d, n), dtype=bool)
+        else:
+            universe = jnp.asarray(universe, dtype=bool)
+        return Panel(values, universe, _as_np_vocab(dates), _as_np_vocab(symbols))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FactorPanel:
+    """A dense stack of factor panels: ``values[F, D, N]`` + shared universe."""
+
+    values: jnp.ndarray  # float[F, D, N]
+    universe: jnp.ndarray  # bool[D, N]
+    dates: np.ndarray = dataclasses.field(metadata=dict(static=True))
+    symbols: np.ndarray = dataclasses.field(metadata=dict(static=True))
+    factor_names: tuple = dataclasses.field(metadata=dict(static=True))
+
+    def __post_init__(self):
+        if self.values.ndim != 3:
+            raise ValueError(f"FactorPanel.values must be [F, D, N], got {self.values.shape}")
+
+    @property
+    def n_factors(self) -> int:
+        return self.values.shape[0]
+
+    def factor(self, name: str) -> Panel:
+        idx = self.factor_names.index(name)
+        return Panel(self.values[idx], self.universe, self.dates, self.symbols)
+
+    def select(self, names: Sequence[str]) -> "FactorPanel":
+        idx = [self.factor_names.index(n) for n in names]
+        return dataclasses.replace(
+            self, values=self.values[np.asarray(idx)], factor_names=tuple(names)
+        )
+
+    @staticmethod
+    def dense(values, dates=None, symbols=None, factor_names=None, universe=None) -> "FactorPanel":
+        values = jnp.asarray(values)
+        f, d, n = values.shape
+        if dates is None:
+            dates = np.arange(d)
+        if symbols is None:
+            symbols = np.arange(n)
+        if factor_names is None:
+            factor_names = tuple(f"f{i}" for i in range(f))
+        if universe is None:
+            universe = jnp.ones((d, n), dtype=bool)
+        else:
+            universe = jnp.asarray(universe, dtype=bool)
+        return FactorPanel(
+            values, universe, _as_np_vocab(dates), _as_np_vocab(symbols), tuple(factor_names)
+        )
+
+
+def from_long(dates_idx, symbols_idx, values, *, n_dates=None, n_symbols=None,
+              dates=None, symbols=None, dtype=jnp.float32):
+    """Densify a long-format (date_idx, symbol_idx) -> value triple into a Panel.
+
+    ``dates_idx`` / ``symbols_idx`` are integer codes (e.g. pandas categorical
+    codes). Cells never referenced are NaN with ``universe=False``; referenced
+    cells get ``universe=True`` even when the value is NaN.
+    """
+    dates_idx = np.asarray(dates_idx)
+    symbols_idx = np.asarray(symbols_idx)
+    if dates_idx.size and (dates_idx.min() < 0 or symbols_idx.min() < 0):
+        raise ValueError(
+            "negative index codes (e.g. pandas Categorical codes for NaN keys) "
+            "would silently wrap; drop NaN-keyed rows before densifying")
+    vals = np.asarray(values, dtype=np.dtype(dtype))
+    d = int(n_dates if n_dates is not None else dates_idx.max() + 1)
+    n = int(n_symbols if n_symbols is not None else symbols_idx.max() + 1)
+    dense = np.full((d, n), np.nan, dtype=vals.dtype)
+    universe = np.zeros((d, n), dtype=bool)
+    dense[dates_idx, symbols_idx] = vals
+    universe[dates_idx, symbols_idx] = True
+    if dates is None:
+        dates = np.arange(d)
+    if symbols is None:
+        symbols = np.arange(n)
+    return Panel(jnp.asarray(dense), jnp.asarray(universe), _as_np_vocab(dates),
+                 _as_np_vocab(symbols))
+
+
+def panel_to_long(panel: Panel):
+    """Host-side inverse of :func:`from_long`: (date_idx, symbol_idx, values)."""
+    universe = np.asarray(panel.universe)
+    values = np.asarray(panel.values)
+    didx, sidx = np.nonzero(universe)
+    return didx, sidx, values[didx, sidx]

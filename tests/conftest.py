@@ -1,0 +1,30 @@
+"""Test configuration: force an 8-virtual-device CPU mesh.
+
+Tests never touch the real TPU: the suite runs on the CPU backend with 8
+virtual devices so sharding/pjit paths are exercised the way a multi-chip mesh
+would be (SURVEY.md section 4). x64 is enabled so oracle comparisons against
+pandas/numpy float64 are exact to tolerance.
+
+Note: this environment's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon frozen into the config, so we must override via
+``jax.config.update`` (env vars alone are too late) before any backend init.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,239 @@
+"""Pandas oracles for kernel tests.
+
+Small, readable reimplementations of the reference library's pandas semantics
+(NaN policies, ddof conventions, tie handling, min_periods) used as ground
+truth for the dense JAX kernels. Test-only code: nothing here ships.
+
+Long-format convention matches the reference: Series/DataFrame indexed by a
+(date, symbol) MultiIndex.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+# ---------------------------------------------------------------- panel utils
+
+def dense_to_long(arr: np.ndarray, universe: np.ndarray | None = None) -> pd.Series:
+    """[D, N] array -> long (date, symbol) Series, dropping non-universe cells."""
+    d, n = arr.shape
+    idx = pd.MultiIndex.from_product(
+        [pd.RangeIndex(d), [f"s{j:03d}" for j in range(n)]], names=["date", "symbol"])
+    s = pd.Series(arr.ravel(), index=idx)
+    if universe is not None:
+        s = s[universe.ravel()]
+    return s
+
+
+def long_to_dense(s: pd.Series, d: int, n: int) -> np.ndarray:
+    out = np.full((d, n), np.nan)
+    dates = s.index.get_level_values("date").to_numpy()
+    syms = s.index.get_level_values("symbol").str.slice(1).astype(int).to_numpy()
+    out[dates, syms] = s.to_numpy(dtype=float, na_value=np.nan)
+    return out
+
+
+# ------------------------------------------------------------- time-series ops
+
+def _by_symbol(s: pd.Series):
+    return s.groupby(level="symbol")
+
+
+def o_ts_sum(s, w):
+    return _by_symbol(s).transform(lambda g: g.rolling(w).sum())
+
+
+def o_ts_mean(s, w):
+    return _by_symbol(s).transform(lambda g: g.rolling(w).mean())
+
+
+def o_ts_std(s, w):
+    return _by_symbol(s).transform(lambda g: g.rolling(w).std())
+
+
+def o_ts_zscore(s, w):
+    def z(g):
+        sd = g.rolling(w).std()
+        sd = sd.where(sd != 0)
+        return (g - g.rolling(w).mean()) / sd
+    return _by_symbol(s).transform(z)
+
+
+def o_ts_rank(s, w):
+    def last_pct_rank(window_vals: pd.Series) -> float:
+        return window_vals.rank(pct=True).iloc[-1]
+    return _by_symbol(s).transform(
+        lambda g: g.rolling(w, min_periods=w).apply(last_pct_rank, raw=False))
+
+
+def o_ts_diff(s, w):
+    return _by_symbol(s).transform(lambda g: g.diff(w))
+
+
+def o_ts_delay(s, w):
+    return _by_symbol(s).transform(lambda g: g.shift(w))
+
+
+def o_ts_decay(s, w):
+    if w < 1:
+        return s
+    coef = np.arange(1, w + 1, dtype=float)
+
+    def wavg(vals: np.ndarray) -> float:
+        return float(np.dot(vals, coef) / coef.sum())
+
+    return _by_symbol(s).transform(
+        lambda g: g.rolling(w, min_periods=w).apply(wavg, raw=True))
+
+
+def o_ts_backfill(s):
+    return _by_symbol(s).transform(lambda g: g.ffill())
+
+
+# --------------------------------------------------------- cross-sectional ops
+
+def _by_date(s: pd.Series):
+    return s.groupby(level="date")
+
+
+def o_cs_rank(s):
+    def norm(g):
+        r = g.rank(method="average")
+        if len(r) <= 1:
+            return 0.5
+        return (r - 1) / (len(r) - 1)
+    return _by_date(s).transform(norm)
+
+
+def o_cs_winsor(s, limits=(0.01, 0.99)):
+    def f(g):
+        if g.notna().sum() < 5:
+            return g
+        return g.clip(lower=g.quantile(limits[0]), upper=g.quantile(limits[1]))
+    return _by_date(s).transform(f)
+
+
+def o_cs_filter_center(s, center=(0.3, 0.7)):
+    def f(g):
+        lo, hi = g.quantile(center[0]), g.quantile(center[1])
+        return g.where((g < lo) | (g > hi), 0)
+    return _by_date(s).transform(f)
+
+
+def o_cs_zscore(s):
+    return _by_date(s).transform(lambda g: (g - g.mean()) / g.std(ddof=0))
+
+
+def o_cs_mean(s):
+    return _by_date(s).transform(lambda g: g.mean())
+
+
+def o_market_neutralize(s):
+    def f(g):
+        mu, sd = g.mean(skipna=True), g.std(skipna=True, ddof=0)
+        if sd == 0 or np.isnan(sd):
+            return pd.Series(0.0, index=g.index)
+        return (g - mu) / sd
+    return _by_date(s).transform(f)
+
+
+# ------------------------------------------------------------------- group ops
+
+def o_bucket(s, bin_range=(0.2, 1.0, 0.2)):
+    low, up, step = bin_range
+    edges = np.arange(low, up + 1e-8, step)
+    labels = list(range(len(edges) - 1))
+    return _by_date(s).transform(
+        lambda g: pd.cut(g, bins=edges, labels=labels, include_lowest=True))
+
+
+def _by_date_group(s: pd.Series, grp: pd.Series):
+    frame = pd.DataFrame({"v": s, "g": grp})
+    return frame.groupby([s.index.get_level_values("date"), "g"])["v"]
+
+
+def o_group_mean(s, grp):
+    return _by_date_group(s, grp).transform(lambda g: g.mean(skipna=True))
+
+
+def o_group_neutralize(s, grp):
+    return _by_date_group(s, grp).transform(lambda g: g - g.mean(skipna=True))
+
+
+def o_group_normalize(s, grp):
+    def f(g):
+        mu, sd = g.mean(skipna=True), g.std(skipna=True, ddof=0)
+        if sd == 0 or np.isnan(sd):
+            return pd.Series(0.0, index=g.index)
+        return (g - mu) / sd
+    return _by_date_group(s, grp).transform(f)
+
+
+def o_group_rank_normalized(s, grp):
+    def f(g):
+        ok = g.dropna()
+        if len(ok) <= 1:
+            return pd.Series(0.5, index=g.index)
+        out = pd.Series(np.nan, index=g.index)
+        out.loc[ok.index] = (ok.rank(method="average") - 1) / (len(ok) - 1)
+        return out
+    return _by_date_group(s, grp).transform(f)
+
+
+# ------------------------------------------------------------- regression ops
+
+def o_cs_regression(y: pd.Series, x: pd.Series, rettype="resid"):
+    out_parts = []
+    frame = pd.DataFrame({"y": y, "x": x})
+    for date, g in frame.groupby(level="date"):
+        ok = g.dropna()
+        vals = pd.Series(np.nan, index=g.index)
+        if len(ok) >= 2:
+            mx, my = ok["x"].mean(), ok["y"].mean()
+            cov = ((ok["x"] - mx) * (ok["y"] - my)).mean()
+            var = ((ok["x"] - mx) ** 2).mean()
+            beta = cov / var
+            alpha = my - beta * mx
+            if rettype == "resid":
+                vals.loc[ok.index] = ok["y"] - (alpha + beta * ok["x"])
+            elif rettype == "beta":
+                vals.loc[ok.index] = beta
+            elif rettype == "alpha":
+                vals.loc[ok.index] = alpha
+            elif rettype == "fitted":
+                vals.loc[ok.index] = alpha + beta * ok["x"]
+            elif rettype == "r2":
+                vary = ((ok["y"] - my) ** 2).mean()
+                vals.loc[ok.index] = cov**2 / (var * vary)
+        out_parts.append(vals)
+    return pd.concat(out_parts).reindex(y.index)
+
+
+def o_ts_regression(y: pd.Series, x: pd.Series, w: int, rettype=2):
+    """Rolling per-symbol OLS over jointly-valid rows (windows span gaps, the
+    reference drops missing rows before rolling)."""
+    frame = pd.DataFrame({"y": y, "x": x}).dropna()
+    pieces = []
+    for sym, g in frame.groupby(level="symbol"):
+        gx, gy = g["x"], g["y"]
+        mx = gx.rolling(w).mean()
+        my = gy.rolling(w).mean()
+        cov = (gx * gy).rolling(w).mean() - mx * my
+        var = (gx**2).rolling(w).mean() - mx**2
+        beta = cov / var
+        alpha = my - beta * mx
+        if rettype == 0:
+            vals = gy - (alpha + beta * gx)
+        elif rettype == 1:
+            vals = alpha
+        elif rettype == 2:
+            vals = beta
+        elif rettype == 3:
+            vals = alpha + beta * gx
+        elif rettype == 6:
+            vary = (gy**2).rolling(w).mean() - my**2
+            vals = cov**2 / (var * vary)
+        pieces.append(vals)
+    return pd.concat(pieces).reindex(y.index)

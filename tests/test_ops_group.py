@@ -1,0 +1,97 @@
+"""Group kernels (bucket / group_*) vs pandas oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu import ops
+from tests import pandas_oracle as po
+
+D, N, G = 13, 12, 4
+
+
+def make_case(rng, nan_frac=0.2):
+    x = rng.normal(size=(D, N))
+    x[rng.uniform(size=(D, N)) < nan_frac] = np.nan
+    gid = rng.integers(0, G, size=(D, N)).astype(np.int32)
+    gid[rng.uniform(size=(D, N)) < 0.1] = -1  # some rows without a group
+    return x, gid
+
+
+def to_oracle_groups(gid):
+    """int ids -> label Series with NaN for missing groups (pandas drops them)."""
+    labels = np.where(gid >= 0, gid.astype(float), np.nan)
+    return po.dense_to_long(labels)
+
+
+def check(kernel_out, oracle_long, atol=1e-9):
+    got = np.asarray(kernel_out)
+    exp = po.long_to_dense(oracle_long.astype(float), D, N)
+    np.testing.assert_allclose(got, exp, atol=atol, equal_nan=True)
+
+
+def test_bucket(rng):
+    x = rng.uniform(0.0, 1.2, size=(D, N))  # includes out-of-range values
+    x[rng.uniform(size=(D, N)) < 0.15] = np.nan
+    x[0, 0] = 0.2  # exactly the lowest edge -> include_lowest puts it in bin 0
+    got = np.asarray(ops.bucket(jnp.array(x)))
+    exp_long = po.o_bucket(po.dense_to_long(x))
+    exp = po.long_to_dense(exp_long.astype(float), D, N)
+    exp = np.where(np.isnan(exp), -1, exp)
+    np.testing.assert_array_equal(got, exp.astype(np.int32))
+
+
+def test_group_mean(rng):
+    x, gid = make_case(rng)
+    s, grp = po.dense_to_long(x), to_oracle_groups(gid)
+    check(ops.group_mean(jnp.array(x), jnp.array(gid), G), po.o_group_mean(s, grp))
+
+
+def test_group_neutralize(rng):
+    x, gid = make_case(rng)
+    s, grp = po.dense_to_long(x), to_oracle_groups(gid)
+    check(ops.group_neutralize(jnp.array(x), jnp.array(gid), G),
+          po.o_group_neutralize(s, grp))
+
+
+def test_group_normalize(rng):
+    x, gid = make_case(rng)
+    x[4, gid[4] == 1] = 0.75  # constant group -> sigma 0 -> zeros
+    s, grp = po.dense_to_long(x), to_oracle_groups(gid)
+    check(ops.group_normalize(jnp.array(x), jnp.array(gid), G),
+          po.o_group_normalize(s, grp))
+
+
+def test_group_rank_normalized(rng):
+    x, gid = make_case(rng, nan_frac=0.35)  # plenty of <=1-valid groups
+    x = np.round(x * 2) / 2  # ties
+    s, grp = po.dense_to_long(x), to_oracle_groups(gid)
+    check(ops.group_rank_normalized(jnp.array(x), jnp.array(gid), G),
+          po.o_group_rank_normalized(s, grp))
+
+
+@pytest.mark.parametrize("rettype", ["resid", "beta", "alpha", "fitted", "r2"])
+def test_cs_regression(rng, rettype):
+    y = rng.normal(size=(D, N))
+    x = 0.5 * y + rng.normal(size=(D, N))
+    y[rng.uniform(size=(D, N)) < 0.2] = np.nan
+    x[rng.uniform(size=(D, N)) < 0.2] = np.nan
+    x[3, 2:] = np.nan  # date with < 2 valid pairs -> all NaN
+    got = np.asarray(ops.cs_regression(jnp.array(y), jnp.array(x), rettype))
+    exp = po.long_to_dense(
+        po.o_cs_regression(po.dense_to_long(y), po.dense_to_long(x), rettype), D, N)
+    np.testing.assert_allclose(got, exp, atol=1e-9, equal_nan=True)
+
+
+@pytest.mark.parametrize("rettype", [0, 1, 2, 3, 6])
+def test_ts_regression_fast(rng, rettype):
+    w = 4
+    y = rng.normal(size=(D, N))
+    x = 0.3 * y + rng.normal(size=(D, N))
+    y[rng.uniform(size=(D, N)) < 0.15] = np.nan
+    x[rng.uniform(size=(D, N)) < 0.15] = np.nan
+    got = np.asarray(ops.ts_regression_fast(jnp.array(y), jnp.array(x), w,
+                                            rettype=rettype))
+    exp = po.long_to_dense(
+        po.o_ts_regression(po.dense_to_long(y), po.dense_to_long(x), w, rettype), D, N)
+    np.testing.assert_allclose(got, exp, atol=1e-8, equal_nan=True)
