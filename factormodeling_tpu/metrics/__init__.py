@@ -1,0 +1,12 @@
+"""Factor scoring (L3): batched IC / rank-IC / factor-return metrics.
+
+Reference surface: ``single_factor_metrics`` (``factor_selector.py:26-73``).
+"""
+
+from factormodeling_tpu.metrics.factor_metrics import (  # noqa: F401
+    METRIC_COLUMNS,
+    aggregate_metrics,
+    daily_factor_stats,
+    rolling_metrics,
+    single_factor_metrics,
+)

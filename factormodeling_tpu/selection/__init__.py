@@ -1,0 +1,18 @@
+"""Rolling factor selection (L3): method registry + vectorized driver.
+
+Reference surface: ``factor_selector.py`` + ``factor_selection_methods.py``.
+"""
+
+from factormodeling_tpu.selection.driver import (  # noqa: F401
+    build_selection_context,
+    rolling_selection,
+)
+from factormodeling_tpu.selection.selectors import (  # noqa: F401
+    FACTOR_SELECTION_METHODS,
+    SelectionContext,
+    factor_momentum_selector,
+    icir_top_selector,
+    mvo_selector,
+    register_selection_method,
+)
+from factormodeling_tpu.selection.shrinkage import ledoit_wolf_shrinkage  # noqa: F401

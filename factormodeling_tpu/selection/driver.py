@@ -1,0 +1,90 @@
+"""Rolling factor-selection driver.
+
+Reference: ``FactorSelector`` (``factor_selector.py:76-139``) — a tqdm loop
+that, for every date, reslices the trailing window and recomputes
+``single_factor_metrics`` from scratch (O(D*W*F) scipy calls, the reference's
+dominant cost, SURVEY.md section 3.2).
+
+TPU design: per-date stats are computed once for the whole sample
+(:func:`daily_factor_stats`), trailing-window metrics come from rolling sums
+(:func:`rolling_metrics`) at O(D*F), and the selector runs vectorized over all
+dates. The reference's date conventions are preserved exactly: exposures are
+shifted twice in the selection path (once at ``FactorSelector.__init__``
+line 84, once inside ``single_factor_metrics`` line 33), windows cover
+``dates[i-window : i]`` (today excluded), processed dates are
+``dates[window : -1]``, and daily weight rows are normalized to sum 1 with
+all-zero rows left at 0 (``factor_selector.py:131-136``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from factormodeling_tpu.metrics import daily_factor_stats, rolling_metrics
+from factormodeling_tpu.ops._window import rolling_sum, shift
+from factormodeling_tpu.selection.selectors import (
+    FACTOR_SELECTION_METHODS,
+    SelectionContext,
+)
+
+__all__ = ["rolling_selection", "build_selection_context"]
+
+
+def build_selection_context(factors: jnp.ndarray, returns: jnp.ndarray,
+                            factor_ret: jnp.ndarray, window: int,
+                            *, universe: jnp.ndarray | None = None,
+                            shift_periods: int = 2) -> SelectionContext:
+    """Precompute the whole-sample tensors selectors consume.
+
+    Args:
+      factors: ``float[F, D, N]`` raw exposures (unshifted).
+      returns: ``float[D, N]`` asset returns.
+      factor_ret: ``float[D, F]`` per-date factor returns (the reference's
+        precomputed ``factor_ret_df``).
+      window: trailing lookback length.
+      shift_periods: total exposure lag in the metrics; the reference's
+        selection path shifts twice (init + metrics), hence the default 2.
+    """
+    daily = daily_factor_stats(factors, returns, shift_periods=shift_periods,
+                               universe=universe)
+    # The reference applies its second exposure shift INSIDE the window slice
+    # (factor_selector.py:84 then :33), so the slice's first date has all-NaN
+    # exposures and contributes no pairs: a window of W dates aggregates only
+    # its last W-1 dates of double-shifted stats.
+    rm = rolling_metrics(daily, max(window - 1, 1))
+    # selectors for date i read the window ending at i-1 (today excluded)
+    metrics_win = {k: shift(v, 1, axis=-1) for k, v in rm.items()}
+
+    ok = ~jnp.isnan(factor_ret)
+    sums = rolling_sum(jnp.where(ok, factor_ret, 0.0), window, axis=0)
+    cnts = rolling_sum(ok.astype(factor_ret.dtype), window, axis=0)
+    return SelectionContext(
+        metrics_win=metrics_win,
+        factor_ret=factor_ret,
+        ret_win_sum=shift(sums, 1, axis=0, fill_value=0.0),
+        ret_win_cnt=shift(cnts, 1, axis=0, fill_value=0.0),
+        window=window,
+    )
+
+
+def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
+                      factor_ret: jnp.ndarray, window: int,
+                      method: str = "icir_top", method_kwargs: dict | None = None,
+                      *, universe: jnp.ndarray | None = None,
+                      shift_periods: int = 2) -> jnp.ndarray:
+    """Daily factor weights ``float[D, F]``: zero outside the processed range
+    ``dates[window:-1]``, rows normalized to sum 1 (all-zero rows stay 0)."""
+    selector = FACTOR_SELECTION_METHODS.get(method)
+    if selector is None:
+        raise ValueError(f"Unknown factor selection method: {method}")
+    ctx = build_selection_context(factors, returns, factor_ret, window,
+                                  universe=universe, shift_periods=shift_periods)
+    raw = selector(ctx, **(method_kwargs or {}))  # [D, F]
+
+    d = factor_ret.shape[0]
+    i = jnp.arange(d)
+    processed = (i >= window) & (i <= d - 2)
+    raw = jnp.where(processed[:, None], raw, 0.0)
+    raw = jnp.where(jnp.isnan(raw), 0.0, raw)
+    rowsum = raw.sum(axis=1, keepdims=True)
+    return jnp.where(rowsum > 0, raw / jnp.where(rowsum > 0, rowsum, 1.0), 0.0)

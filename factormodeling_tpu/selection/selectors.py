@@ -1,0 +1,140 @@
+"""Factor-selection methods: the plugin surface behind the registry.
+
+Reference: ``factor_selection_methods.py`` (icir_top / momentum / mvo) driven
+by ``FactorSelector.prepare_selection`` (``factor_selector.py:94-139``).
+
+TPU design: a selector consumes a :class:`SelectionContext` of precomputed
+whole-sample tensors (per-date factor stats, trailing-window metric tensors,
+windowed factor-return sums) and emits raw daily weight rows for ALL dates at
+once — ``float[D, F]``, later masked to the processed date range and
+row-normalized by the driver. The reference's per-day Python loop becomes one
+vectorized expression (icir_top, momentum) or a `lax.map`-batched QP sweep
+(mvo). Custom selectors plug in through the same registry dict the reference
+exposes (``factor_selector.py:20-24``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from factormodeling_tpu.ops._window import rolling_sum
+from factormodeling_tpu.selection.shrinkage import ledoit_wolf_shrinkage
+from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_dense
+
+__all__ = [
+    "SelectionContext",
+    "FACTOR_SELECTION_METHODS",
+    "register_selection_method",
+    "icir_top_selector",
+    "factor_momentum_selector",
+    "mvo_selector",
+]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SelectionContext:
+    """Everything a selector may need, precomputed once for the whole sample.
+
+    Window convention: ``metrics_win[...][f, t]`` aggregates dates
+    ``t-window+1 .. t`` inclusive. A selector choosing weights *for* date
+    index ``i`` must read window tensors at ``i-1`` (the reference's window
+    excludes today, ``factor_selector.py:110``); the driver pre-shifts, so
+    selectors read column ``t`` directly.
+    """
+
+    metrics_win: dict      # name -> float[F, D] trailing-window metrics (shifted)
+    factor_ret: jnp.ndarray  # float[D, F] per-date factor returns (raw)
+    ret_win_sum: jnp.ndarray  # float[D, F] trailing-window NaN-skipping sums (shifted)
+    ret_win_cnt: jnp.ndarray  # float[D, F] trailing-window non-NaN counts (shifted)
+    window: int = dataclasses.field(metadata=dict(static=True))
+
+
+def icir_top_selector(ctx: SelectionContext, *, icir_threshold: float = 0.03,
+                      top_x: int = 5, use_rank_icir: bool = True,
+                      **_ignored) -> jnp.ndarray:
+    """Equal-weight the top ``top_x`` factors whose (rank-)ICIR exceeds the
+    threshold (reference ``factor_selection_methods.py:6-26``)."""
+    score = ctx.metrics_win["rank_IC_IR" if use_rank_icir else "IC_IR"]  # [F, D]
+    eligible = score > icir_threshold  # NaN -> False, like pandas nlargest
+    keyed = jnp.where(eligible, score, -jnp.inf)
+    # stable descending rank; ties keep first-factor order like nlargest
+    order = jnp.argsort(-keyed, axis=0, stable=True)
+    rank_of = jnp.argsort(order, axis=0, stable=True)
+    chosen = eligible & (rank_of < top_x)
+    return chosen.astype(score.dtype).T  # [D, F]
+
+
+def factor_momentum_selector(ctx: SelectionContext, *, max_weight: float = 1.0,
+                             **_ignored) -> jnp.ndarray:
+    """Weight proportional to clip(window-sum of factor returns, 0, cap)
+    (reference ``factor_selection_methods.py:28-58``)."""
+    mom = jnp.maximum(ctx.ret_win_sum, 0.0)  # [D, F]
+    if max_weight < 1.0:
+        mom = jnp.minimum(mom, max_weight)
+    return mom
+
+
+def mvo_selector(ctx: SelectionContext, *, risk_aversion: float = 1.0,
+                 max_weight: float = 1.0, turnover_penalty: float = 0.0,
+                 use_shrinkage: bool = True, qp_iters: int = 500,
+                 batch_size: int = 32, **_ignored) -> jnp.ndarray:
+    """Max-Sharpe factor weights: maximize ``mu'w - gamma w'Sigma w`` on the
+    capped simplex via the batched ADMM QP (reference
+    ``factor_selection_methods.py:119-175``; cvxpy/OSQP replaced on-device).
+
+    The covariance of each trailing window is built per date from a dynamic
+    slice of the factor-return panel inside a ``lax.map`` (chunked so at most
+    ``batch_size`` windows are resident), then Ledoit-Wolf-shrunk in closed
+    form. A non-finite problem (NaN in the window) yields zero weights, the
+    reference's failure fallback.
+
+    Note: the reference never threads ``previous_weights`` through the daily
+    loop (always None), so the turnover term is inert there; here it is wired
+    for standalone use but defaults off.
+    """
+    d_dates, f = ctx.factor_ret.shape
+    ret = ctx.factor_ret
+    cap = max_weight if max_weight < 1.0 else 1.0
+    window = ctx.window
+
+    def solve_one(today_idx):
+        start = jnp.maximum(today_idx - window, 0)
+        win = lax.dynamic_slice(ret, (start, 0), (window, f))  # [W, F]
+        mu = jnp.nanmean(win, axis=0)
+        if use_shrinkage:
+            cov = ledoit_wolf_shrinkage(win)
+        else:
+            c = win - win.mean(axis=0, keepdims=True)
+            cov = (c.T @ c) / (window - 1)
+        cov = 0.5 * (cov + cov.T)
+        prob = BoxQPProblem(
+            q=-mu, lo=jnp.zeros(f, ret.dtype), hi=jnp.full(f, cap, ret.dtype),
+            E=jnp.ones((1, f), ret.dtype), b=jnp.ones(1, ret.dtype),
+            l1=jnp.asarray(turnover_penalty, ret.dtype),
+            center=jnp.zeros(f, ret.dtype))
+        res = admm_solve_dense(2.0 * risk_aversion * cov, prob, iters=qp_iters)
+        w = res.x
+        ok = jnp.all(jnp.isfinite(w))
+        return jnp.where(ok, jnp.maximum(w, 0.0), 0.0)
+
+    idx = jnp.arange(d_dates)
+    return lax.map(solve_one, idx, batch_size=batch_size)  # [D, F]
+
+
+FACTOR_SELECTION_METHODS: dict[str, Callable] = {
+    "icir_top": icir_top_selector,
+    "momentum": factor_momentum_selector,
+    "mvo": mvo_selector,
+}
+
+
+def register_selection_method(name: str, fn: Callable) -> None:
+    """Extend the selector registry (the reference's plugin boundary,
+    ``factor_selector.py:20-24``)."""
+    FACTOR_SELECTION_METHODS[name] = fn

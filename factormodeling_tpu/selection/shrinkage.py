@@ -1,0 +1,46 @@
+"""Ledoit-Wolf constant-correlation shrinkage, closed form on device.
+
+Reference: ``factor_selection_methods.py:60-117``. The reference estimates the
+shrinkage intensity with an O(n * p^2) Python loop over observations building
+``outer(c_k, c_k)`` one row at a time; here every moment it needs reduces to
+matmuls of the centered data matrix (MXU-friendly, no per-observation loop):
+
+    sum_k (c_ki c_kj - S_ij)^2
+  =  (C^2)' C^2  - 2 S . (C' C)  +  n S^2     (elementwise in i, j)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ledoit_wolf_shrinkage"]
+
+
+def ledoit_wolf_shrinkage(returns: jnp.ndarray) -> jnp.ndarray:
+    """Shrink the sample covariance of ``returns [T, F]`` toward the
+    constant-correlation target; returns ``[F, F]``."""
+    t, p = returns.shape
+    c = returns - returns.mean(axis=0, keepdims=True)
+    sample = (c.T @ c) / (t - 1)
+
+    var = jnp.diag(sample)
+    std = jnp.sqrt(var)
+    denom = std[:, None] * std[None, :]
+    offdiag = ~jnp.eye(p, dtype=bool)
+    ok = (denom > 0) & offdiag
+    corr = jnp.where(ok, sample / jnp.where(denom > 0, denom, 1.0), 0.0)
+    n_ok = ok.sum()
+    mean_corr = jnp.where(n_ok > 0, corr.sum() / jnp.where(n_ok > 0, n_ok, 1), 0.0)
+
+    target = jnp.where(offdiag, mean_corr * denom, jnp.diag(var))
+
+    d = ((sample - target) ** 2).sum()
+    c2 = c * c
+    # sum_k (c_ki c_kj - S_ij)^2, expanded into matmul moments
+    fourth = c2.T @ c2
+    cross = sample * (c.T @ c)
+    phi = (fourth - 2.0 * cross + t * sample * sample).sum() / t
+
+    lam = jnp.where(d > 0, phi / d, 1.0)
+    lam = jnp.clip(lam, 0.0, 1.0)
+    return lam * target + (1.0 - lam) * sample

@@ -1,0 +1,14 @@
+"""Batched QP solvers (replaces the reference's cvxpy/OSQP + scipy SLSQP).
+
+The reference solves thousands of small-to-mid QPs one at a time on the host
+(``portfolio_simulation.py:376-746``, ``factor_selection_methods.py:151-167``).
+Here a fixed-iteration ADMM solver runs entirely on device, vmaps over dates,
+and exploits the low-rank structure of return covariances so the asset-level
+problems never materialize an N x N matrix.
+"""
+
+from factormodeling_tpu.solvers.admm_qp import (  # noqa: F401
+    BoxQPProblem,
+    admm_solve_dense,
+    admm_solve_lowrank,
+)

@@ -237,3 +237,106 @@ def o_ts_regression(y: pd.Series, x: pd.Series, w: int, rettype=2):
             vals = cov**2 / (var * vary)
         pieces.append(vals)
     return pd.concat(pieces).reindex(y.index)
+
+
+# -------------------------------------------------------- factor scoring layer
+
+def o_single_factor_metrics(factors_df: pd.DataFrame, returns: pd.Series,
+                            shift_periods: int = 1) -> pd.DataFrame:
+    """Per-factor IC / rank-IC / factor-return metric table (reference
+    factor_selector.py:26-73 semantics)."""
+    from scipy import stats as sps
+
+    shifted = factors_df.groupby(level="symbol").shift(shift_periods)
+    rows = {}
+    for fac in factors_df.columns:
+        pair = pd.concat([shifted[fac].rename("f"), returns.rename("r")], axis=1).dropna()
+        ics, rics, betas = [], [], []
+        for _, g in pair.groupby(level="date"):
+            f, r = g["f"].to_numpy(), g["r"].to_numpy()
+            if len(f) < 3:
+                continue
+            with np.errstate(all="ignore"):
+                ics.append(sps.pearsonr(f, r)[0] if len(set(f)) > 1 and len(set(r)) > 1 else np.nan)
+                rics.append(sps.pearsonr(sps.rankdata(f), r)[0]
+                            if len(set(f)) > 1 and len(set(r)) > 1 else np.nan)
+            den = float(np.dot(f, f))
+            if den > 0:
+                betas.append(float(np.dot(f, r)) / den)
+        ica = np.array([v for v in ics if not np.isnan(v)])
+        rica = np.array([v for v in rics if not np.isnan(v)])
+        ba = np.asarray(betas)
+        t, p = (sps.ttest_1samp(ba, 0) if ba.size > 1 else (np.nan, np.nan))
+        rows[fac] = {
+            "IC": ica.mean() if ica.size else np.nan,
+            "IC_IR": ica.mean() / ica.std(ddof=1) if ica.size > 1 else np.nan,
+            "rank_IC": rica.mean() if rica.size else np.nan,
+            "rank_IC_IR": rica.mean() / rica.std(ddof=1) if rica.size > 1 else np.nan,
+            "factor_return_tstat": float(t),
+            "factor_return_pvalue": float(p),
+            "pct_pos_factor_return": float((ba > 0).mean()) if ba.size else np.nan,
+        }
+    return pd.DataFrame(rows).T
+
+
+def o_ledoit_wolf(returns: np.ndarray) -> np.ndarray:
+    """Constant-correlation Ledoit-Wolf shrinkage, observation-loop form
+    (reference factor_selection_methods.py:60-117 semantics)."""
+    n, p = returns.shape
+    s = np.cov(returns, rowvar=False)
+    var = np.diag(s)
+    std = np.sqrt(var)
+    cors = [s[i, j] / (std[i] * std[j])
+            for i in range(p) for j in range(i + 1, p)
+            if std[i] > 0 and std[j] > 0]
+    mc = np.mean(cors) if cors else 0.0
+    target = mc * np.outer(std, std)
+    np.fill_diagonal(target, var)
+    d = np.sum((s - target) ** 2)
+    c = returns - returns.mean(axis=0)
+    acc = np.zeros((p, p))
+    for k in range(n):
+        acc += (np.outer(c[k], c[k]) - s) ** 2
+    acc /= n
+    lam = np.sum(acc) / d if d > 0 else 1.0
+    lam = max(0.0, min(1.0, lam))
+    return lam * target + (1 - lam) * s
+
+
+def o_rolling_selection(factors_df, returns, factor_ret_df, window, method,
+                        method_kwargs=None):
+    """Rolling selection loop (reference factor_selector.py:94-139 semantics):
+    exposures shifted once here + once in metrics; window excludes today;
+    processed dates are dates[window:-1]; daily rows normalized to sum 1."""
+    method_kwargs = method_kwargs or {}
+    shifted = factors_df.groupby(level="symbol").shift(1)
+    dates = sorted(set(shifted.index.get_level_values("date"))
+                   & set(factor_ret_df.index))
+    vecs = {}
+    for i in range(window, len(dates) - 1):
+        wdates = dates[i - window:i]
+        fwin = shifted.loc[wdates]
+        rwin = returns.loc[wdates]
+        frwin = factor_ret_df.loc[wdates]
+        metrics = o_single_factor_metrics(fwin, rwin)
+        if method == "icir_top":
+            col = "rank_IC_IR" if method_kwargs.get("use_rank_icir", True) else "IC_IR"
+            thr = method_kwargs.get("icir_threshold", 0.03)
+            topx = method_kwargs.get("top_x", 5)
+            elig = metrics[metrics[col] > thr].nlargest(topx, col)
+            vec = pd.Series(0.0, index=metrics.index)
+            vec.loc[elig.index] = 1.0
+        elif method == "momentum":
+            mom = frwin[metrics.index.tolist()].sum().clip(lower=0)
+            mw = method_kwargs.get("max_weight", 1.0)
+            if mw < 1.0:
+                mom = mom.clip(upper=mw)
+            vec = mom
+        else:
+            raise ValueError(method)
+        if vec.sum() > 0:
+            vec = vec / vec.sum()
+        vecs[dates[i]] = vec
+    sel = pd.DataFrame(vecs).T
+    sel = sel.div(sel.sum(axis=1), axis=0).fillna(0)
+    return sel

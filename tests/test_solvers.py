@@ -1,0 +1,141 @@
+"""ADMM QP solver vs scipy SLSQP on the workload's three problem shapes."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.optimize import minimize
+
+from factormodeling_tpu.solvers import (
+    BoxQPProblem,
+    admm_solve_dense,
+    admm_solve_lowrank,
+)
+
+
+def scipy_qp(P, q, lo, hi, E, b, l1=0.0, center=None):
+    n = len(q)
+    center = np.zeros(n) if center is None else center
+
+    def obj(w):
+        return 0.5 * w @ P @ w + q @ w + np.sum(l1 * np.abs(w - center))
+
+    cons = [{"type": "eq", "fun": (lambda w, row=E[k], bk=b[k]: row @ w - bk)}
+            for k in range(len(b))]
+    x0 = np.clip(np.linalg.lstsq(E, b, rcond=None)[0], lo, hi)
+    r = minimize(obj, x0, method="SLSQP", bounds=list(zip(lo, hi)),
+                 constraints=cons, options={"maxiter": 500, "ftol": 1e-12})
+    return r.x, obj(r.x)
+
+
+def test_simplex_mvo_matches_slsqp(rng):
+    """Factor-selection shape: capped simplex, tiny covariance scale."""
+    f = 10
+    ret = rng.normal(0, 1e-3, size=(60, f))
+    P = 2 * (np.cov(ret, rowvar=False) + 1e-8 * np.eye(f))
+    q = -ret.mean(0)
+    lo, hi = np.zeros(f), np.full(f, 0.3)
+    E, b = np.ones((1, f)), np.array([1.0])
+    prob = BoxQPProblem(jnp.array(q), jnp.array(lo), jnp.array(hi),
+                        jnp.array(E), jnp.array(b), jnp.array(0.0), jnp.zeros(f))
+    res = admm_solve_dense(jnp.array(P), prob, iters=2000)
+    x = np.asarray(res.x)
+    _, f_exp = scipy_qp(P, q, lo, hi, E, b)
+    f_got = 0.5 * x @ P @ x + q @ x
+    assert float(res.primal_residual) < 1e-6
+    np.testing.assert_allclose(x.sum(), 1.0, atol=1e-10)
+    assert f_got <= f_exp + 1e-9 * max(1, abs(f_exp))
+
+
+def _asset_case(rng, n=30, t=20, cap=0.2):
+    R = rng.normal(0, 0.02, size=(t, n))
+    C = R - R.mean(0)
+    lam = 0.1
+    sample_diag = np.diag(np.cov(R, rowvar=False) + 1e-6 * np.eye(n))
+    alpha = (1 - lam) * 1e-6 + lam * sample_diag.mean()
+    c = (1 - lam) / (t - 1)
+    Pfull = alpha * np.eye(n) + c * (C.T @ C)
+    sig = rng.normal(size=n)
+    sig[rng.uniform(size=n) < 0.3] = 0.0
+    pos, neg = sig > 0, sig < 0
+    # keep both legs feasible: count * cap must exceed 1
+    assert pos.sum() * cap > 1 and neg.sum() * cap > 1
+    lo = np.where(pos, 0.0, np.where(neg, -cap, 0.0))
+    hi = np.where(pos, cap, 0.0)
+    E = np.stack([pos.astype(float), neg.astype(float)])
+    b = np.array([1.0, -1.0])
+    return Pfull, alpha, C, c, sig, pos, neg, lo, hi, E, b
+
+
+def test_two_leg_mvo_lowrank_matches_dense_and_slsqp(rng):
+    Pfull, alpha, C, c, sig, pos, neg, lo, hi, E, b = _asset_case(rng)
+    n, t = Pfull.shape[0], C.shape[0]
+    prob = BoxQPProblem(jnp.zeros(n), jnp.array(lo), jnp.array(hi),
+                        jnp.array(E), jnp.array(b), jnp.array(0.0), jnp.zeros(n))
+    res = admm_solve_lowrank(jnp.array(alpha), jnp.array(C), jnp.full(t, c),
+                             prob, iters=2000)
+    x = np.asarray(res.x)
+    _, f_exp = scipy_qp(Pfull, np.zeros(n), lo, hi, E, b)
+    f_got = 0.5 * x @ Pfull @ x
+    np.testing.assert_allclose(x[pos].sum(), 1.0, atol=1e-9)
+    np.testing.assert_allclose(x[neg].sum(), -1.0, atol=1e-9)
+    assert np.abs(x[~pos & ~neg]).max() < 1e-8  # pinned names stay at zero
+    assert f_got <= f_exp * 1.02 + 1e-12
+
+    # low-rank path must agree with the dense path on the same problem
+    res_d = admm_solve_dense(jnp.array(Pfull), prob, iters=2000)
+    np.testing.assert_allclose(x, np.asarray(res_d.x), atol=5e-5)
+
+
+def test_turnover_l1_term(rng):
+    Pfull, alpha, C, c, sig, pos, neg, lo, hi, E, b = _asset_case(rng)
+    n, t = Pfull.shape[0], C.shape[0]
+    prev = np.zeros(n)
+    prev[pos] = 1.0 / pos.sum()
+    prev[neg] = -1.0 / neg.sum()
+    tp, rw = 0.1, 0.05
+    q = -rw * sig
+    prob = BoxQPProblem(jnp.array(q), jnp.array(lo), jnp.array(hi),
+                        jnp.array(E), jnp.array(b), jnp.array(tp), jnp.array(prev))
+    res = admm_solve_lowrank(jnp.array(alpha), jnp.array(C), jnp.full(t, c),
+                             prob, iters=3000)
+    x = np.asarray(res.x)
+    _, f_exp = scipy_qp(Pfull, q, lo, hi, E, b, l1=tp, center=prev)
+    f_got = 0.5 * x @ Pfull @ x + q @ x + tp * np.abs(x - prev).sum()
+    np.testing.assert_allclose(x[pos].sum(), 1.0, atol=1e-9)
+    np.testing.assert_allclose(x[neg].sum(), -1.0, atol=1e-9)
+    # L1 objectives are flat near the optimum; accept matching-or-better
+    assert f_got <= f_exp + 1e-4 * max(1.0, abs(f_exp))
+
+    # a huge turnover penalty must pin the solution at prev
+    big = BoxQPProblem(jnp.array(q), jnp.array(lo), jnp.array(hi),
+                       jnp.array(E), jnp.array(b), jnp.array(1e3), jnp.array(prev))
+    res_big = admm_solve_lowrank(jnp.array(alpha), jnp.array(C), jnp.full(t, c),
+                                 big, iters=2000)
+    np.testing.assert_allclose(np.asarray(res_big.x), prev, atol=1e-6)
+
+
+def test_vmap_batch_of_problems(rng):
+    """The solver must vmap over dates (the engine's usage pattern)."""
+    import jax
+
+    f = 6
+    Ps, qs = [], []
+    for _ in range(4):
+        ret = rng.normal(0, 1e-3, size=(30, f))
+        Ps.append(2 * (np.cov(ret, rowvar=False) + 1e-8 * np.eye(f)))
+        qs.append(-ret.mean(0))
+    Ps, qs = np.stack(Ps), np.stack(qs)
+    lo, hi = np.zeros(f), np.full(f, 1.0)
+    E, b = np.ones((1, f)), np.array([1.0])
+
+    def solve(P, q):
+        prob = BoxQPProblem(q, jnp.array(lo), jnp.array(hi), jnp.array(E),
+                            jnp.array(b), jnp.array(0.0), jnp.zeros(f))
+        return admm_solve_dense(P, prob, iters=800).x
+
+    xs = np.asarray(jax.vmap(solve)(jnp.array(Ps), jnp.array(qs)))
+    for k in range(4):
+        _, f_exp = scipy_qp(Ps[k], qs[k], lo, hi, E, b)
+        f_got = 0.5 * xs[k] @ Ps[k] @ xs[k] + qs[k] @ xs[k]
+        np.testing.assert_allclose(xs[k].sum(), 1.0, atol=1e-8)
+        assert f_got <= f_exp + 1e-8
