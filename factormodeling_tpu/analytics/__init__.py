@@ -1,0 +1,13 @@
+"""Analytics & reporting (L0). Reference surface: ``portfolio_analyzer.py``
+plus the plotting/quantile helpers of ``composite_factor.py``."""
+
+from factormodeling_tpu.analytics.analyzer import PortfolioAnalyzer  # noqa: F401
+from factormodeling_tpu.analytics.plots import (  # noqa: F401
+    plot_factor_distributions,
+    plot_full_performance,
+    plot_quantile_backtests,
+)
+from factormodeling_tpu.analytics.quantile import (  # noqa: F401
+    QuantileBacktest,
+    quantile_backtest_log,
+)

@@ -1,0 +1,86 @@
+"""Per-factor quantile bucket backtests in log space.
+
+Reference: ``quantile_backtest_log`` inside ``plot_quantile_backtests_log``
+(``composite_factor.py:47-134``): per date, qcut the factor's ordinal ranks
+into n buckets (1 = top), shift labels one day per symbol, average log-returns
+per (date, bucket), cumulate in log space and ``expm1`` back, plus the
+``L1 - Sn`` long/short spread.
+
+TPU design: pandas ``qcut(rank(method='first'), n)`` on m distinct ordinal
+ranks has closed-form bin edges ``1 + (m-1) * j / n`` — so bucketing is a
+broadcast compare against n+1 edges, batched over all dates and factors.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from factormodeling_tpu.ops._rank import segment_avg_rank
+from factormodeling_tpu.ops._window import masked_shift, shift
+
+__all__ = ["QuantileBacktest", "quantile_backtest_log"]
+
+_N_AXIS = -1
+
+
+class QuantileBacktest(NamedTuple):
+    group_log: jnp.ndarray   # [..., D, G] per-date mean log-return per bucket (1=top first)
+    cum: jnp.ndarray         # [..., D, G] expm1(skipna-cumsum) per bucket
+    spread_log: jnp.ndarray  # [..., D] bucket-1 minus bucket-n log return
+    spread_cum: jnp.ndarray  # [..., D] cumulative spread
+
+
+def _ordinal_rank(x: jnp.ndarray) -> jnp.ndarray:
+    """pandas ``rank(method='first')``: ties broken by position, NaN -> NaN."""
+    valid = ~jnp.isnan(x)
+    n = x.shape[_N_AXIS]
+    key = jnp.where(valid, x, jnp.inf)
+    order = jnp.argsort(key, axis=_N_AXIS, stable=True)
+    rank0 = jnp.argsort(order, axis=_N_AXIS, stable=True)
+    return jnp.where(valid, rank0 + 1.0, jnp.nan)
+
+
+def _skipna_cumsum(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    out = jnp.cumsum(jnp.where(jnp.isnan(x), 0.0, x), axis=axis)
+    return jnp.where(jnp.isnan(x), jnp.nan, out)
+
+
+def quantile_backtest_log(feature: jnp.ndarray, returns: jnp.ndarray,
+                          n_groups: int = 5,
+                          universe: jnp.ndarray | None = None) -> QuantileBacktest:
+    """Bucket backtest of ``feature [..., D, N]`` against log-returns
+    ``[D, N]``; buckets ordered 1=top .. n=bottom like the reference."""
+    if universe is not None:
+        feature = jnp.where(universe, feature, jnp.nan)
+        returns = jnp.where(universe, returns, jnp.nan)
+    r = _ordinal_rank(feature)
+    valid = ~jnp.isnan(r)
+    m = valid.sum(_N_AXIS, keepdims=True).astype(feature.dtype)
+
+    # qcut edges over ordinal ranks 1..m: e_j = 1 + (m-1) j/n, bins (e_j, e_j+1]
+    # with include_lowest; label = #edges strictly below r (clipped at bin 0).
+    j = jnp.arange(1, n_groups, dtype=feature.dtype)
+    edges = 1.0 + (m[..., None] - 1.0) * j / n_groups   # [..., D, 1, n-1]
+    lbl0 = (r[..., None] > edges).sum(-1).astype(feature.dtype)
+    lbl0 = jnp.where(valid, lbl0, jnp.nan)
+    inv = n_groups - lbl0  # 1 = top
+
+    if universe is not None:
+        lagged = masked_shift(inv, universe, 1, axis=-2)
+    else:
+        lagged = shift(inv, 1, axis=-2)
+
+    ok = ~jnp.isnan(lagged) & ~jnp.isnan(returns)
+    grp_ids = jnp.where(ok, lagged - 1.0, 0.0).astype(jnp.int32)  # 0..n-1
+    onehot = (grp_ids[..., None] == jnp.arange(n_groups)) & ok[..., None]
+    rsum = jnp.where(ok, jnp.nan_to_num(returns), 0.0)
+    sums = (onehot * rsum[..., None]).sum(-2)           # [..., D, G]
+    cnts = onehot.sum(-2).astype(feature.dtype)
+    group_log = sums / jnp.where(cnts > 0, cnts, jnp.nan)
+
+    cum = jnp.expm1(_skipna_cumsum(group_log, axis=-2))
+    spread_log = group_log[..., 0] - group_log[..., n_groups - 1]
+    spread_cum = jnp.expm1(_skipna_cumsum(spread_log, axis=-1))
+    return QuantileBacktest(group_log, cum, spread_log, spread_cum)

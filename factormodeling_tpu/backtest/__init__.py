@@ -1,0 +1,20 @@
+"""Backtest engine (L4). Reference surface: ``portfolio_simulation.py``."""
+
+from factormodeling_tpu.backtest.engine import (  # noqa: F401
+    SimulationOutput,
+    daily_trade_list,
+    run_simulation,
+)
+from factormodeling_tpu.backtest.mvo import mvo_turnover_weights, mvo_weights  # noqa: F401
+from factormodeling_tpu.backtest.pnl import (  # noqa: F401
+    DailyResult,
+    daily_portfolio_returns,
+    signal_metrics,
+)
+from factormodeling_tpu.backtest.settings import TCOST_RATES, SimulationSettings  # noqa: F401
+from factormodeling_tpu.backtest.weights import (  # noqa: F401
+    cap_and_redistribute,
+    equal_weights,
+    linear_weights,
+    normalize_legs,
+)

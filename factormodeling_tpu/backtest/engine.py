@@ -1,0 +1,65 @@
+"""Simulation engine: signal -> daily weights -> shifted trades -> P&L.
+
+Reference: ``Simulation`` (``portfolio_simulation.py:35-154``). The reference
+mutates the shared ``factors_df`` on ``run()`` (line 72) — a side effect
+deliberately NOT replicated; the compat layer reproduces it at the pandas
+boundary where it belongs.
+
+Pipeline (all device-side, one jit):
+  1. mask the signal by the investability flag (``:73``);
+  2. per-date weights by scheme — equal/linear are batched cross-sections,
+     mvo a chunked ``lax.map`` of QP solves, mvo_turnover a ``lax.scan``;
+  3. trade on yesterday's signal: weights shift 1 day per symbol (``:152``);
+  4. P&L with tiered costs (``pnl.py``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from factormodeling_tpu.backtest.mvo import mvo_turnover_weights, mvo_weights
+from factormodeling_tpu.backtest.pnl import DailyResult, daily_portfolio_returns
+from factormodeling_tpu.backtest.settings import SimulationSettings
+from factormodeling_tpu.backtest.weights import equal_weights, linear_weights
+from factormodeling_tpu.ops._window import masked_shift, shift
+
+__all__ = ["SimulationOutput", "daily_trade_list", "run_simulation"]
+
+
+class SimulationOutput(NamedTuple):
+    weights: jnp.ndarray       # [D, N] shifted trade weights (NaN pre-history)
+    long_count: jnp.ndarray    # [D]
+    short_count: jnp.ndarray   # [D]
+    result: DailyResult
+
+
+def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
+    """Daily weights for the chosen scheme, shifted one day per symbol
+    (reference ``_daily_trade_list``). Returns (weights, long/short counts)."""
+    if s.method == "equal":
+        w, lc, sc = equal_weights(signal, s.pct)
+    elif s.method == "linear":
+        w, lc, sc = linear_weights(signal, s.max_weight)
+    elif s.method == "mvo":
+        w, lc, sc = mvo_weights(signal, s)
+    else:  # mvo_turnover
+        w, lc, sc = mvo_turnover_weights(signal, s)
+
+    if s.universe is not None:
+        shifted = masked_shift(w, s.universe, 1, axis=0)
+    else:
+        shifted = shift(w, 1, axis=0)
+    return shifted, lc, sc
+
+
+def run_simulation(signal: jnp.ndarray, s: SimulationSettings) -> SimulationOutput:
+    """Full backtest of a signal panel under the settings (reference
+    ``Simulation.run`` minus host-side printing/plotting, which live in
+    :mod:`factormodeling_tpu.analytics`)."""
+    masked = signal * s.investability_flag
+    weights, lc, sc = daily_trade_list(masked, s)
+    result = daily_portfolio_returns(weights, s)
+    return SimulationOutput(weights=weights, long_count=lc, short_count=sc,
+                            result=result)

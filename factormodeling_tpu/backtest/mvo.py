@@ -1,0 +1,188 @@
+"""MVO weight schemes: per-date minimum-variance long/short allocation, with
+an optional turnover-penalized sequential variant.
+
+Reference: ``portfolio_simulation.py:183-248,315-374,376-746``. Per date the
+reference pivots a trailing returns window to a pandas frame, forms an N x N
+sample covariance (+1e-6 jitter, then identity shrinkage) and hands a QP to
+OSQP or SLSQP on the host — 5-7 s/date measured (SURVEY.md section 6).
+
+TPU design: the covariance never materializes. Each date's problem keeps the
+factored form
+
+    Sigma_shrunk = alpha I + s C' C,
+    alpha = (1 - lam) * 1e-6 + lam * mean(diag(sample + 1e-6 I)),
+    s     = (1 - lam) / (T - 1),   C = centered zero-filled window rows,
+
+which the ADMM solver consumes through a Woodbury identity (T x T inner
+Cholesky, T = lookback ~ 60). Plain ``mvo`` runs all dates through a chunked
+``lax.map``; ``mvo_turnover`` is a ``lax.scan`` because yesterday's weights
+enter the objective (``portfolio_simulation.py:206-225``).
+
+Fallback ladder, matching the reference's failure semantics:
+- either leg empty -> flat day (handled by the engine);
+- universe row has < 2 names -> flat day (``portfolio_simulation.py:119``);
+- no prior dates (covariance ``None``) -> equal-scheme weights
+  (``portfolio_simulation.py:188-190``);
+- exactly 1 prior date (NaN sample covariance) or solver failure /
+  infeasible caps -> equal-weight x0 on the signal legs
+  (``portfolio_simulation.py:452-459``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from factormodeling_tpu.backtest.settings import SimulationSettings
+from factormodeling_tpu.backtest.weights import equal_weights, leg_masks
+from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_lowrank
+
+__all__ = ["mvo_weights", "mvo_turnover_weights"]
+
+_JITTER = 1e-6
+
+
+def _window_factors(returns: jnp.ndarray, today: jnp.ndarray, lookback: int):
+    """(alpha, C, s, T) of the factored shrunk covariance for one date.
+
+    Rows are the (zero-filled) return rows strictly before ``today``, at most
+    ``lookback`` of them (``portfolio_simulation.py:315-359``).
+    """
+    d, n = returns.shape
+    start = jnp.maximum(today - lookback, 0)
+    t_used = today - start  # number of usable rows
+    rows = lax.dynamic_slice(jnp.nan_to_num(returns), (start, 0), (lookback, n))
+    used = (jnp.arange(lookback) < t_used)[:, None]
+    rows = jnp.where(used, rows, 0.0)
+    tf = jnp.maximum(t_used, 1).astype(returns.dtype)
+    mean = rows.sum(0, keepdims=True) / tf
+    c = jnp.where(used, rows - mean, 0.0)
+    return c, t_used
+
+
+def _shrunk_terms(c: jnp.ndarray, t_used, lam: float, dtype):
+    """alpha and per-row scale of Sigma_shrunk = alpha I + s C'C."""
+    denom = jnp.maximum(t_used - 1, 1).astype(dtype)
+    s_row = (1.0 - lam) / denom
+    # avg sample variance incl. jitter: mean_j (C'C)_jj / (T-1) + 1e-6
+    n = c.shape[1]
+    avg_var = (c * c).sum() / denom / n + _JITTER
+    alpha = (1.0 - lam) * _JITTER + lam * avg_var
+    return alpha, s_row
+
+
+def _x0_legs(signal_row: jnp.ndarray) -> jnp.ndarray:
+    """The reference's solver-failure fallback: equal weights per leg
+    (``portfolio_simulation.py:387-390``)."""
+    pos = signal_row > 0
+    neg = signal_row < 0
+    cp = jnp.maximum(pos.sum(), 1).astype(signal_row.dtype)
+    cn = jnp.maximum(neg.sum(), 1).astype(signal_row.dtype)
+    return pos.astype(signal_row.dtype) / cp - neg.astype(signal_row.dtype) / cn
+
+
+def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
+               s: SimulationSettings, turnover: bool):
+    """One date's MVO solve with the full fallback ladder. Returns [N]."""
+    n = signal_row.shape[0]
+    dtype = returns.dtype
+    pos = signal_row > 0
+    neg = signal_row < 0
+
+    c, t_used = _window_factors(returns, today, s.lookback_period)
+    alpha, s_row = _shrunk_terms(c, t_used, s.shrinkage_intensity, dtype)
+    s_vec = jnp.where(jnp.arange(s.lookback_period) < t_used, s_row, 0.0)
+
+    lo = jnp.where(pos, 0.0, jnp.where(neg, -s.max_weight, 0.0)).astype(dtype)
+    hi = jnp.where(pos, s.max_weight, 0.0).astype(dtype)
+    E = jnp.stack([pos.astype(dtype), neg.astype(dtype)])
+    b = jnp.asarray([1.0, -1.0], dtype)
+    if turnover:
+        q = (-s.return_weight) * jnp.nan_to_num(signal_row).astype(dtype)
+        l1 = jnp.asarray(s.turnover_penalty, dtype)
+        center = w_prev.astype(dtype)
+    else:
+        q = jnp.zeros(n, dtype)
+        l1 = jnp.asarray(0.0, dtype)
+        center = jnp.zeros(n, dtype)
+    # the reference objective is w' Sigma w (cvxpy quad_form, NOT halved) plus
+    # the linear/L1 terms; the ADMM solver minimizes 1/2 x'Px + ..., so P must
+    # be 2 Sigma for the trade-off against the L1/return terms to match.
+    prob = BoxQPProblem(q=q, lo=lo, hi=hi, E=E, b=b, l1=l1, center=center)
+    res = admm_solve_lowrank(2.0 * alpha, c, 2.0 * s_vec, prob,
+                             rho=s.qp_rho, iters=s.qp_iters)
+    w = res.x
+
+    feasible = (pos.sum() * s.max_weight >= 1.0) & (neg.sum() * s.max_weight >= 1.0)
+    solver_ok = jnp.all(jnp.isfinite(w)) & feasible & (t_used >= 2)
+    w = jnp.where(solver_ok, w, _x0_legs(signal_row))
+
+    if turnover:
+        # post-solve pruning + per-leg renorm (portfolio_simulation.py:553-573)
+        pruned = jnp.where(jnp.abs(w) < 1e-6, 0.0, w)
+        long_den = jnp.where(pos, pruned, 0.0).sum()
+        short_den = -jnp.where(neg, pruned, 0.0).sum()
+        renorm = jnp.where(pos, pruned / jnp.where(long_den > 0, long_den, 1.0),
+                           jnp.where(neg, pruned / jnp.where(short_den > 0, short_den, 1.0),
+                                     0.0))
+        w = jnp.where(solver_ok & (long_den > 0) & (short_den > 0), renorm, w)
+
+    # covariance None (no history at all) -> equal-scheme fallback
+    eq_row, _, _ = equal_weights(signal_row[None, :], s.pct)
+    w = jnp.where(t_used >= 1, w, eq_row[0])
+    return w
+
+
+def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
+    """Per-date minimum-variance weights for the whole panel
+    (``portfolio_simulation.py:183-204``). Dates are independent -> chunked
+    ``lax.map``. Returns (weights [D, N], long_count [D], short_count [D])."""
+    d, n = signal.shape
+    pos, neg, flat = leg_masks(signal)
+
+    def one(today):
+        return _solve_day(signal[today], s.returns, today, jnp.zeros(n, s.returns.dtype),
+                          s, turnover=False)
+
+    w = lax.map(one, jnp.arange(d), batch_size=s.mvo_batch)
+    return _finalize(w, signal, s, pos, neg, flat)
+
+
+def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
+    """Sequential variant: yesterday's (pre-shift) weights feed today's L1
+    turnover term (``portfolio_simulation.py:227-248``) -> ``lax.scan``."""
+    d, n = signal.shape
+    pos, neg, flat = leg_masks(signal)
+    # the reference's _get_previous_weights reads the last stored row, which
+    # is the zero row on flat days — mirror that by carrying the final row.
+    zero_day = flat | (_universe_count(signal, s) < 2)
+
+    def step(w_prev, today):
+        w = _solve_day(signal[today], s.returns, today, w_prev, s, turnover=True)
+        w = jnp.where(zero_day[today], 0.0, w)
+        return w, w
+
+    _, w = lax.scan(step, jnp.zeros(n, s.returns.dtype), jnp.arange(d))
+    return _finalize(w, signal, s, pos, neg, flat)
+
+
+def _universe_count(signal: jnp.ndarray, s: SimulationSettings):
+    if s.universe is not None:
+        return s.universe.sum(-1)
+    return jnp.full(signal.shape[:-1], signal.shape[-1])
+
+
+def _finalize(w, signal, s, pos, neg, flat):
+    zero_day = flat | (_universe_count(signal, s) < 2)
+    w = jnp.where(zero_day[..., None], 0.0, w)
+    zero = jnp.zeros_like(pos.sum(-1))
+    lc = pos.sum(-1)
+    sc = neg.sum(-1)
+    # no-history days fall back to the equal scheme and report its k counts
+    # (portfolio_simulation.py:188-190) — with a dense date axis that is day 0.
+    no_hist = jnp.arange(signal.shape[0]) == 0
+    k_long = jnp.maximum(jnp.floor(lc * s.pct), 1.0).astype(lc.dtype)
+    k_short = jnp.maximum(jnp.floor(sc * s.pct), 1.0).astype(sc.dtype)
+    lc = jnp.where(no_hist, k_long, lc)
+    sc = jnp.where(no_hist, k_short, sc)
+    return w, jnp.where(zero_day, zero, lc), jnp.where(zero_day, zero, sc)

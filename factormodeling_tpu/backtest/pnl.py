@@ -1,0 +1,118 @@
+"""Daily portfolio P&L with tiered transaction costs.
+
+Reference: ``_daily_portfolio_returns`` (``portfolio_simulation.py:748-797``)
+and ``_calculate_metrics`` (``:799-819``). Already panel-shaped in the
+reference (wide pivots); here the dense arrays skip the pivot entirely —
+every column is one reduction over the asset axis.
+
+Semantics notes carried over faithfully:
+- weights/returns NaN cells are zero-filled (the reference's
+  ``unstack().fillna(0)``), so the first post-shift date trades nothing;
+- day-over-day turnover diffs treat the first date as 0 (pandas diff -> NaN
+  -> skipna sums);
+- the net column is *named* ``log_return`` but is the weighted sum of
+  log-returns (an approximation the analyzer exponentiates,
+  ``portfolio_analyzer.py:18``) — preserved numerically, documented honestly;
+- per-name contributor P&L always subtracts costs, regardless of the
+  ``transaction_cost`` flag (``portfolio_simulation.py:793-794``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from factormodeling_tpu.backtest.settings import SimulationSettings
+from factormodeling_tpu.ops._window import shift
+
+__all__ = ["DailyResult", "daily_portfolio_returns", "signal_metrics"]
+
+_N_AXIS = -1
+
+
+class DailyResult(NamedTuple):
+    log_return: jnp.ndarray      # [D] net daily return (after costs if enabled)
+    long_return: jnp.ndarray     # [D]
+    short_return: jnp.ndarray    # [D]
+    long_turnover: jnp.ndarray   # [D]
+    short_turnover: jnp.ndarray  # [D]
+    turnover: jnp.ndarray        # [D]
+    long_pnl_by_name: jnp.ndarray   # [N] after-cost per-name long P&L
+    short_pnl_by_name: jnp.ndarray  # [N] after-cost per-name short P&L
+
+
+def daily_portfolio_returns(weights: jnp.ndarray,
+                            s: SimulationSettings) -> DailyResult:
+    """P&L of (already shifted) daily weights against the settings panels."""
+    w = jnp.nan_to_num(weights)
+    r = jnp.nan_to_num(s.returns)
+    longs = jnp.maximum(w, 0.0)
+    shorts = jnp.abs(jnp.minimum(w, 0.0))
+
+    long_ret_raw = (longs * r).sum(_N_AXIS)
+    short_ret_raw = -(shorts * r).sum(_N_AXIS)
+
+    dlong = jnp.abs(longs - shift(longs, 1, axis=0, fill_value=jnp.nan))
+    dshort = jnp.abs(shorts - shift(shorts, 1, axis=0, fill_value=jnp.nan))
+    dlong = jnp.nan_to_num(dlong)   # first date: pandas diff NaN -> 0
+    dshort = jnp.nan_to_num(dshort)
+    lt = dlong.sum(_N_AXIS)
+    st = dshort.sum(_N_AXIS)
+
+    rates = s.cost_rates()
+    l_cost = (dlong * rates).sum(_N_AXIS)
+    s_cost = (dshort * rates).sum(_N_AXIS)
+    if s.transaction_cost:
+        long_ret = long_ret_raw - l_cost
+        short_ret = short_ret_raw - s_cost
+    else:
+        long_ret, short_ret = long_ret_raw, short_ret_raw
+
+    long_by_name = (longs * r).sum(0) - (dlong * rates).sum(0)
+    short_by_name = -(shorts * r).sum(0) - (dshort * rates).sum(0)
+
+    return DailyResult(
+        log_return=long_ret + short_ret,
+        long_return=long_ret,
+        short_return=short_ret,
+        long_turnover=lt,
+        short_turnover=st,
+        turnover=lt + st,
+        long_pnl_by_name=long_by_name,
+        short_pnl_by_name=short_by_name,
+    )
+
+
+def signal_metrics(signal: jnp.ndarray, weights: jnp.ndarray,
+                   s: SimulationSettings) -> dict:
+    """Daily signal IC and turnover summary (``portfolio_simulation.py:799``):
+    per-date Pearson corr of signal vs same-day returns, its mean/std/IR, and
+    the average daily total turnover."""
+    valid = ~jnp.isnan(signal) & ~jnp.isnan(s.returns)
+    cnt = valid.sum(_N_AXIS).astype(s.returns.dtype)
+    cs = jnp.where(cnt > 0, cnt, jnp.nan)
+    a0 = jnp.where(valid, signal, 0.0)
+    r0 = jnp.where(valid, s.returns, 0.0)
+    ma = a0.sum(_N_AXIS) / cs
+    mr = r0.sum(_N_AXIS) / cs
+    da = jnp.where(valid, signal - ma[:, None], 0.0)
+    dr = jnp.where(valid, s.returns - mr[:, None], 0.0)
+    ic = (da * dr).sum(_N_AXIS) / jnp.sqrt((da * da).sum(_N_AXIS) *
+                                           (dr * dr).sum(_N_AXIS))
+    ok = ~jnp.isnan(ic)
+    n = ok.sum().astype(s.returns.dtype)
+    ns = jnp.where(n > 0, n, jnp.nan)
+    mean = jnp.where(ok, ic, 0.0).sum() / ns
+    dev = jnp.where(ok, ic - mean, 0.0)
+    std = jnp.sqrt((dev * dev).sum() / jnp.where(n > 1, n - 1.0, jnp.nan))
+
+    w = jnp.nan_to_num(weights)
+    longs = jnp.maximum(w, 0.0)
+    shorts = jnp.abs(jnp.minimum(w, 0.0))
+    dl = jnp.nan_to_num(jnp.abs(longs - shift(longs, 1, axis=0)))
+    ds = jnp.nan_to_num(jnp.abs(shorts - shift(shorts, 1, axis=0)))
+    avg_turn = (dl.sum(_N_AXIS) + ds.sum(_N_AXIS)).mean()
+
+    return {"IC": mean, "IC_IR": mean / std, "IC_Std": std,
+            "Avg Turnover": avg_turn}

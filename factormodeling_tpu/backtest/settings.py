@@ -1,0 +1,66 @@
+"""Simulation settings (dense analog of the reference's dataclass).
+
+Reference: ``SimulationSettings`` (``portfolio_simulation.py:10-33``). Market
+data panels become dense ``float[D, N]`` arrays + an optional universe mask;
+all knobs keep the reference's names and defaults. ``min_universe`` is kept
+for API parity — the reference declares and unpacks it but never uses it
+(``portfolio_simulation.py:22,59``). Extra ``qp_*`` knobs configure the ADMM
+solver replacing cvxpy/OSQP (the reference's ``use_cvxpy`` / ``mvo_solver``
+switch between two host solvers; on TPU there is one device solver).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SimulationSettings", "TCOST_RATES"]
+
+# per-cap-tier one-way transaction-cost rates (portfolio_simulation.py:769)
+TCOST_RATES = (0.0, 0.0025, 0.0015, 0.0010)  # index = cap_flag 0..3
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SimulationSettings:
+    # market data (dense panels)
+    returns: jnp.ndarray              # float[D, N] daily log-returns
+    cap_flag: jnp.ndarray             # float/int[D, N] cap tier 1/2/3
+    investability_flag: jnp.ndarray   # float[D, N] 0/1 (NaN allowed)
+    universe: jnp.ndarray | None = None  # bool[D, N] long-index membership
+
+    # simulation parameters
+    method: str = dataclasses.field(default="equal", metadata=dict(static=True))
+    transaction_cost: bool = dataclasses.field(default=True, metadata=dict(static=True))
+    max_weight: float = 0.03
+    pct: float = 0.1
+    min_universe: int = 1000          # parity only; unused (see module docstring)
+    contributor: bool = dataclasses.field(default=False, metadata=dict(static=True))
+
+    # MVO knobs
+    lookback_period: int = dataclasses.field(default=60, metadata=dict(static=True))
+    shrinkage_intensity: float = 0.1
+    turnover_penalty: float = 0.1
+    return_weight: float = 0.0
+
+    # ADMM solver knobs (device-side replacement for OSQP/SLSQP)
+    qp_iters: int = dataclasses.field(default=500, metadata=dict(static=True))
+    qp_rho: float = dataclasses.field(default=2.0, metadata=dict(static=True))
+    mvo_batch: int = dataclasses.field(default=32, metadata=dict(static=True))
+
+    def __post_init__(self):
+        if self.method not in ("equal", "linear", "mvo", "mvo_turnover"):
+            raise ValueError(f"Unknown method {self.method}")
+
+    @property
+    def shape(self):
+        return self.returns.shape
+
+    def cost_rates(self) -> jnp.ndarray:
+        """Per-cell one-way cost rates from the cap tier (missing tier -> 0)."""
+        table = jnp.asarray(np.asarray(TCOST_RATES), dtype=self.returns.dtype)
+        flags = jnp.nan_to_num(self.cap_flag).astype(jnp.int32)
+        return table[jnp.clip(flags, 0, len(TCOST_RATES) - 1)]

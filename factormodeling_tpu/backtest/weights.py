@@ -1,0 +1,122 @@
+"""Daily weight schemes: equal and linear (the vmappable, QP-free paths).
+
+Reference: ``portfolio_simulation.py:156-181,250-313``. Both schemes are
+per-date cross-sectional transforms of the signal row, so the whole [D, N]
+panel processes in one batched kernel — the reference's tqdm date loop
+disappears.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["leg_masks", "equal_weights", "linear_weights",
+           "normalize_legs", "cap_and_redistribute"]
+
+_N_AXIS = -1
+
+
+def leg_masks(signal: jnp.ndarray):
+    """(pos, neg, flat_day): sign masks (NaN is neither) and the stay-flat
+    condition — either leg empty (``portfolio_simulation.py:109``)."""
+    pos = signal > 0.0
+    neg = signal < 0.0
+    flat = (~pos.any(_N_AXIS)) | (~neg.any(_N_AXIS))
+    return pos, neg, flat
+
+
+def normalize_legs(w: jnp.ndarray) -> jnp.ndarray:
+    """Long leg sums to +1, short leg to -1 (``portfolio_simulation.py:250``)."""
+    wp = jnp.maximum(w, 0.0)
+    wn = jnp.minimum(w, 0.0)
+    sp = wp.sum(_N_AXIS, keepdims=True)
+    sn = -wn.sum(_N_AXIS, keepdims=True)
+    wp = jnp.where(sp > 0, wp / jnp.where(sp > 0, sp, 1.0), wp)
+    wn = jnp.where(sn > 0, wn / jnp.where(sn > 0, sn, 1.0), wn)
+    return wp + wn
+
+
+def _desc_rank(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """0-based descending rank among masked cells (stable on ties)."""
+    keyed = jnp.where(mask, values, -jnp.inf)
+    order = jnp.argsort(-keyed, axis=_N_AXIS, stable=True)
+    return jnp.argsort(order, axis=_N_AXIS, stable=True)
+
+
+def equal_weights(signal: jnp.ndarray, pct: float):
+    """Top-``pct`` of each leg at +-1, legs normalized
+    (``portfolio_simulation.py:156-170``): k = max(floor(count * pct), 1).
+
+    Returns (weights [D, N], long_count [D], short_count [D]).
+    """
+    pos, neg, flat = leg_masks(signal)
+    cp = pos.sum(_N_AXIS)
+    cn = neg.sum(_N_AXIS)
+    k_long = jnp.maximum(jnp.floor(cp * pct), 1.0).astype(jnp.int32)
+    k_short = jnp.maximum(jnp.floor(cn * pct), 1.0).astype(jnp.int32)
+
+    rl = _desc_rank(signal, pos)
+    rs = _desc_rank(-signal, neg)
+    sel_long = pos & (rl < k_long[..., None])
+    sel_short = neg & (rs < k_short[..., None])
+    w = sel_long.astype(signal.dtype) - sel_short.astype(signal.dtype)
+    w = normalize_legs(w)
+    w = jnp.where(flat[..., None], 0.0, w)
+    return w, jnp.where(flat, 0, k_long), jnp.where(flat, 0, k_short)
+
+
+def cap_and_redistribute(w: jnp.ndarray, max_weight: float,
+                         max_iter: int = 10, tol: float = 1e-6) -> jnp.ndarray:
+    """Per-name cap with iterative pro-rata redistribution of the excess
+    (``portfolio_simulation.py:264-313``), as a fixed-``max_iter`` masked loop:
+    converged dates freeze exactly where the reference's ``break`` leaves them.
+    """
+
+    def body(_, state):
+        w_cur, frozen = state
+        capped = jnp.clip(w_cur, -max_weight, max_weight)
+        long_excess = 1.0 - jnp.where(capped > 0, capped, 0.0).sum(_N_AXIS, keepdims=True)
+        short_excess = -1.0 - jnp.where(capped < 0, capped, 0.0).sum(_N_AXIS, keepdims=True)
+        ul = (w_cur > 0) & (capped < max_weight)
+        us = (w_cur < 0) & (capped > -max_weight)
+        has_ul = ul.any(_N_AXIS, keepdims=True)
+        has_us = us.any(_N_AXIS, keepdims=True)
+        done = ((jnp.abs(long_excess) < tol) & (jnp.abs(short_excess) < tol)) | \
+               (~has_ul & ~has_us)
+
+        ul_vals = jnp.where(ul, capped, 0.0)
+        ul_sum = ul_vals.sum(_N_AXIS, keepdims=True)
+        add_l = jnp.where(
+            has_ul & (jnp.abs(long_excess) > tol),
+            long_excess * ul_vals / jnp.where(ul_sum != 0, ul_sum, 1.0), 0.0)
+        us_vals = jnp.where(us, capped, 0.0)
+        us_sum = us_vals.sum(_N_AXIS, keepdims=True)
+        add_s = jnp.where(
+            has_us & (jnp.abs(short_excess) > tol),
+            short_excess * us_vals / jnp.where(us_sum != 0, us_sum, 1.0), 0.0)
+
+        w_next = capped + add_l + add_s
+        newly_frozen = frozen | done
+        w_out = jnp.where(newly_frozen, w_cur, w_next)
+        return w_out, newly_frozen
+
+    frozen0 = jnp.zeros(w.shape[:-1] + (1,), dtype=bool)
+    w_fin, _ = lax.fori_loop(0, max_iter, body, (w, frozen0))
+    return jnp.clip(w_fin, -max_weight, max_weight)
+
+
+def linear_weights(signal: jnp.ndarray, max_weight: float):
+    """Weights proportional to the signal, legs normalized, then capped with
+    redistribution (``portfolio_simulation.py:172-181``).
+
+    Returns (weights [D, N], long_count [D], short_count [D]).
+    """
+    pos, neg, flat = leg_masks(signal)
+    w = jnp.where(pos | neg, jnp.nan_to_num(signal), 0.0)
+    w = normalize_legs(w)
+    w = cap_and_redistribute(w, max_weight)
+    w = jnp.where(flat[..., None], 0.0, w)
+    zero = jnp.zeros_like(pos.sum(_N_AXIS))
+    return (w, jnp.where(flat, zero, pos.sum(_N_AXIS)),
+            jnp.where(flat, zero, neg.sum(_N_AXIS)))

@@ -1,0 +1,9 @@
+"""Composite-factor blending (L3). Reference surface: ``composite_factor.py``."""
+
+from factormodeling_tpu.composite.blend import (  # noqa: F401
+    SUFFIXES,
+    composite_static,
+    composite_weighted,
+    prefix_group_ids,
+    suffix_code,
+)

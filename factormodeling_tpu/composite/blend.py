@@ -1,0 +1,261 @@
+"""Composite-factor construction: suffix preprocessing, prefix-group proxies,
+zscore/rank blending — static and per-date weighted variants.
+
+Reference: ``composite_factor.py:137-342``. Factor naming convention:
+``<prefix>_<suffix>`` with suffix in {_eq, _flx, _long, _short} selecting a
+per-date preprocessing rule and prefix defining the proxy group.
+
+Semantics preserved exactly (including quirks):
+
+- static path computes suffix percentiles PER COLUMN per date
+  (``composite_factor.py:157-175``); the weighted path POOLS all same-suffix
+  columns for the day's percentiles (``composite_factor.py:251-268``).
+- ``_eq`` maps NaN to 0 (both comparisons false); the linear suffixes
+  propagate NaN; degenerate days (hi == lo or no data) zero the column(s).
+- proxies are NaN-skipping means of their member factors; the static zscore
+  blend nanmeans proxies, the static rank blend SUMS them; the weighted blend
+  is a weighted sum where NaN propagates, later zero-filled
+  (``composite_factor.py:341``).
+- rank transforms call scipy ``rankdata`` on raw arrays; since scipy 1.10
+  the default ``nan_policy='propagate'`` makes a single NaN poison the whole
+  column's ranks for that date — reproduced exactly (the static rank-sum then
+  contributes 0 for that group, pandas' skipna sum; the weighted path goes
+  NaN and is zero-filled).
+- the weighted composite is only defined on selection dates, weights <= 0
+  drop a factor for the day, group weights renormalize (equal weights when
+  they sum to 0), and the final panel is zero-filled.
+
+TPU design: one pass over dense ``[F, D, N]`` stacks; suffix classes are
+static host-side index sets; group-proxy means are one einsum over a
+``[G, F]`` membership one-hot (MXU); every per-date loop in the reference is
+a batched kernel here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from factormodeling_tpu.ops._rank import avg_rank, masked_quantile
+
+__all__ = [
+    "composite_static",
+    "composite_weighted",
+    "suffix_code",
+    "prefix_group_ids",
+    "SUFFIXES",
+]
+
+SUFFIXES = ("_eq", "_flx", "_long", "_short")
+_SUFFIX_QS = {"_eq": (0.10, 0.90), "_flx": (0.02, 0.98),
+              "_long": (0.02, 0.98), "_short": (0.02, 0.98)}
+
+
+def suffix_code(name: str) -> str | None:
+    for s in SUFFIXES:
+        if name.endswith(s):
+            return s
+    return None
+
+
+def prefix_group_ids(names) -> tuple[np.ndarray, list[str]]:
+    """Group id per factor by the prefix before the first underscore
+    (``composite_factor.py:180-184``); returns (gid[F], group prefixes)."""
+    prefixes = []
+    gids = []
+    for n in names:
+        p = n.split("_", 1)[0]
+        if p not in prefixes:
+            prefixes.append(p)
+        gids.append(prefixes.index(p))
+    return np.asarray(gids, dtype=np.int32), prefixes
+
+
+def _apply_suffix(vals: jnp.ndarray, sfx: str, lo: jnp.ndarray, hi: jnp.ndarray,
+                  degenerate: jnp.ndarray) -> jnp.ndarray:
+    """One suffix rule on ``vals[..., N]`` given per-row lo/hi/degenerate."""
+    if sfx == "_eq":
+        out = jnp.where(vals <= lo, -1.0, jnp.where(vals >= hi, 1.0, 0.0))
+    else:
+        span = hi - lo
+        clipped = jnp.clip(vals, lo, hi)
+        if sfx == "_flx":
+            out = (clipped - lo) / span * 2.0 - 1.0
+        elif sfx == "_long":
+            out = (clipped - lo) / span
+        else:  # _short
+            out = (clipped - hi) / span
+    return jnp.where(degenerate, 0.0, out)
+
+
+def _preprocess(vals: jnp.ndarray, names, *, pooled: bool,
+                active: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Suffix preprocessing over a ``[F, D, N]`` stack.
+
+    ``pooled=False``: per-column percentiles (static path).
+    ``pooled=True``: per-suffix pooled percentiles over the day's active
+    columns (weighted path); ``active`` is ``bool[D, F]``.
+    """
+    f, d, n = vals.shape
+    out = vals
+    for sfx in SUFFIXES:
+        idx = [i for i, nm in enumerate(names) if nm.endswith(sfx)]
+        if not idx:
+            continue
+        qlo, qhi = _SUFFIX_QS[sfx]
+        sub = vals[np.asarray(idx)]  # [K, D, N]
+        if pooled:
+            pool = jnp.swapaxes(sub, 0, 1).reshape(d, len(idx) * n)  # [D, K*N]
+            if active is not None:
+                act = active[:, np.asarray(idx)]  # [D, K]
+                mask = jnp.repeat(act, n, axis=1)
+                pool = jnp.where(mask, pool, jnp.nan)
+            qs = masked_quantile(pool, jnp.asarray([qlo, qhi], vals.dtype))  # [D, 2]
+            lo = qs[:, 0][None, :, None]
+            hi = qs[:, 1][None, :, None]
+        else:
+            qs = masked_quantile(sub, jnp.asarray([qlo, qhi], vals.dtype))  # [K, D, 2]
+            lo = qs[..., 0][..., None]
+            hi = qs[..., 1][..., None]
+        degenerate = jnp.isnan(lo) | jnp.isnan(hi) | (hi == lo)
+        transformed = _apply_suffix(sub, sfx, lo, hi, degenerate)
+        out = out.at[np.asarray(idx)].set(transformed)
+    return out
+
+
+def _group_proxies(adj: jnp.ndarray, gids: np.ndarray, n_groups: int,
+                   member_weight: jnp.ndarray | None = None) -> jnp.ndarray:
+    """NaN-skipping mean over each prefix group's member factors:
+    ``[F, D, N] -> [G, D, N]``. ``member_weight [D, F]`` (0/1) restricts to
+    the day's active factors."""
+    onehot = jnp.asarray(np.arange(n_groups)[:, None] == gids, dtype=adj.dtype)  # [G, F]
+    valid = ~jnp.isnan(adj)
+    filled = jnp.where(valid, adj, 0.0)
+    v = valid.astype(adj.dtype)
+    if member_weight is not None:
+        mw = member_weight.T[:, :, None]  # [F, D, 1]
+        filled = filled * mw
+        v = v * mw
+    sums = jnp.einsum("gf,fdn->gdn", onehot, filled)
+    cnts = jnp.einsum("gf,fdn->gdn", onehot, v)
+    return sums / jnp.where(cnts > 0, cnts, jnp.nan)
+
+
+def _safe_zscore_rows(x: jnp.ndarray, universe: jnp.ndarray | None) -> jnp.ndarray:
+    """Per-row zscore ddof=0 over valid cells; sigma 0/undefined -> whole row 0
+    (the blend's ``safe_zcol``, ``composite_factor.py:195-200``)."""
+    if universe is not None:
+        x = jnp.where(universe, x, jnp.nan)
+    valid = ~jnp.isnan(x)
+    cnt = valid.sum(-1, keepdims=True).astype(x.dtype)
+    cs = jnp.where(cnt > 0, cnt, jnp.nan)
+    mu = jnp.where(valid, x, 0.0).sum(-1, keepdims=True) / cs
+    dev = jnp.where(valid, x - mu, 0.0)
+    sd = jnp.sqrt((dev * dev).sum(-1, keepdims=True) / cs)
+    degenerate = (sd == 0.0) | jnp.isnan(sd)
+    return jnp.where(degenerate, 0.0, (x - mu) / sd)
+
+
+def _rank_propagate(x: jnp.ndarray, universe: jnp.ndarray | None) -> jnp.ndarray:
+    """``(rankdata(x) - 1) / (len(x) - 1)`` with scipy's modern NaN rule
+    (``nan_policy='propagate'``, the default since scipy 1.10, which the
+    reference's environment uses): one NaN makes the WHOLE row's ranks NaN.
+    ``len`` counts the full row / universe."""
+    if universe is not None:
+        x = jnp.where(universe, x, jnp.nan)
+        cnt = jnp.sum(jnp.broadcast_to(universe, x.shape), -1,
+                      keepdims=True).astype(x.dtype)
+        isn = jnp.isnan(x) & jnp.broadcast_to(universe, x.shape)
+    else:
+        cnt = jnp.full(x.shape[:-1] + (1,), x.shape[-1], x.dtype)
+        isn = jnp.isnan(x)
+    r = avg_rank(x, axis=-1)
+    out = (r - 1.0) / (cnt - 1.0)
+    return jnp.where(isn.any(-1, keepdims=True), jnp.nan, out)
+
+
+def _demean_rows(x: jnp.ndarray, universe: jnp.ndarray | None) -> jnp.ndarray:
+    if universe is not None:
+        x = jnp.where(universe, x, jnp.nan)
+    valid = ~jnp.isnan(x)
+    cnt = valid.sum(-1, keepdims=True).astype(x.dtype)
+    mu = jnp.where(valid, x, 0.0).sum(-1, keepdims=True) / jnp.where(cnt > 0, cnt, jnp.nan)
+    return x - mu
+
+
+def composite_static(factors: jnp.ndarray, names, method: str = "zscore",
+                     universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Static equal blend of ``factors [F, D, N]`` (reference
+    ``composite_factor_calculation``, ``composite_factor.py:137-218``).
+    Returns the demeaned composite ``float[D, N]`` (NaN preserved)."""
+    if method not in ("zscore", "rank"):
+        raise ValueError("method must be 'zscore' or 'rank'")
+    gids, prefixes = prefix_group_ids(names)
+    if universe is not None:
+        factors = jnp.where(universe, factors, jnp.nan)
+    adj = _preprocess(factors, names, pooled=False)
+    proxies = _group_proxies(adj, gids, len(prefixes))  # [G, D, N]
+    if method == "zscore":
+        normed = _safe_zscore_rows(proxies, universe)
+        valid = ~jnp.isnan(normed)
+        cnt = valid.sum(0).astype(factors.dtype)
+        comp = jnp.where(valid, normed, 0.0).sum(0) / jnp.where(cnt > 0, cnt, jnp.nan)
+    else:
+        ranks = _rank_propagate(proxies, universe)
+        # pandas .sum(axis=1) skipna: NaN rank columns contribute nothing
+        comp = jnp.where(jnp.isnan(ranks), 0.0, ranks).sum(0)
+    comp = _demean_rows(comp, universe)
+    if universe is not None:
+        comp = jnp.where(universe, comp, jnp.nan)
+    return comp
+
+
+def composite_weighted(factors: jnp.ndarray, names, selection: jnp.ndarray,
+                       method: str = "zscore",
+                       universe: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-date weighted blend driven by daily selection weights
+    (reference ``weighted_composite_factor``, ``composite_factor.py:220-342``).
+
+    ``selection [D, F]`` aligns with ``names``; rows that are all zero (dates
+    outside the selection) produce 0. Output is zero-filled like the
+    reference's final ``reindex().fillna(0)`` — ``float[D, N]``.
+    """
+    if method not in ("zscore", "rank"):
+        raise ValueError("method must be 'zscore' or 'rank'")
+    f, d, n = factors.shape
+    gids, prefixes = prefix_group_ids(names)
+    g = len(prefixes)
+    if universe is not None:
+        factors = jnp.where(universe, factors, jnp.nan)
+
+    active = selection > 0.0  # [D, F]
+    adj = _preprocess(factors, names, pooled=True, active=active)
+    member = active.astype(factors.dtype)
+    proxies = _group_proxies(adj, gids, g, member_weight=member)  # [G, D, N]
+
+    onehot = jnp.asarray(np.arange(g)[:, None] == gids, factors.dtype)  # [G, F]
+    gw = jnp.einsum("gf,df->dg", onehot, jnp.where(active, selection, 0.0))  # [D, G]
+    g_active = jnp.einsum("gf,df->dg", onehot, member) > 0  # [D, G]
+    total = gw.sum(-1, keepdims=True)
+    n_active = g_active.sum(-1, keepdims=True).astype(factors.dtype)
+    equal = jnp.where(g_active, 1.0 / jnp.where(n_active > 0, n_active, jnp.nan), 0.0)
+    gw = jnp.where(total > 0, gw / jnp.where(total > 0, total, 1.0), equal)  # [D, G]
+
+    if method == "zscore":
+        normed = _safe_zscore_rows(proxies, universe)
+    else:
+        normed = _rank_propagate(proxies, universe)
+    # weighted sum over active groups; NaN in any active proxy propagates
+    # (python sum of Series in the reference), zero-filled at the end.
+    contrib = jnp.where(g_active.T[:, :, None], normed * gw.T[:, :, None], 0.0)
+    nan_hit = (g_active.T[:, :, None] & jnp.isnan(normed)).any(0)
+    comp = contrib.sum(0)
+    comp = jnp.where(nan_hit, jnp.nan, comp)
+
+    has_day = active.any(-1)  # [D]
+    comp = jnp.where(has_day[:, None], comp, jnp.nan)
+    comp = _demean_rows(comp, universe)
+    comp = jnp.where(jnp.isnan(comp), 0.0, comp)
+    if universe is not None:
+        comp = jnp.where(universe, comp, jnp.nan)
+    return comp

@@ -133,7 +133,7 @@ def _nan_mean_std(x: jnp.ndarray, axis: int):
 def aggregate_metrics(daily: dict, *, axis: int = -1) -> dict:
     """Aggregate per-date stats into the reference's per-factor metric table
     (``factor_selector.py:50-70``). ``axis`` is the date axis of the [F, D]
-    inputs. Returns a dict of ``METRIC_COLUMns`` -> float[F]."""
+    inputs. Returns a dict of ``METRIC_COLUMNS`` -> float[F]."""
     ic_mean, ic_std, _ = _nan_mean_std(daily["ic"], axis)
     ric_mean, ric_std, _ = _nan_mean_std(daily["rank_ic"], axis)
     b_mean, b_std, b_n = _nan_mean_std(daily["factor_return"], axis)

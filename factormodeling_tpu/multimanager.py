@@ -1,0 +1,79 @@
+"""Multi-manager layer (L5): one simulated book per factor, combined by daily
+factor weights into a single portfolio.
+
+Reference: ``multi_manager.py``. The reference runs one full
+``_daily_trade_list`` pass per factor sequentially, then a per-date Python
+loop to combine books (``multi_manager.py:41-73``).
+
+TPU design: the per-factor weight pass is ``vmap``'d over the manager axis
+(one compiled kernel producing ``[M, D, N]`` books), and the combination is a
+single einsum contraction over managers. NaN semantics of the reference's
+``.add(..., fill_value=0)`` carry over: a manager with weight 0 that day is
+skipped entirely (its NaNs don't poison the sum), an active manager's NaN
+propagates and is later zero-filled by the P&L pivots.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from factormodeling_tpu.backtest.engine import daily_trade_list
+from factormodeling_tpu.backtest.pnl import DailyResult, daily_portfolio_returns
+from factormodeling_tpu.backtest.settings import SimulationSettings
+
+__all__ = ["MultiManagerOutput", "compute_manager_weights",
+           "compute_multimanager_weights", "run_multimanager_backtest"]
+
+
+class MultiManagerOutput(NamedTuple):
+    weights: jnp.ndarray      # [D, N] combined (already shifted) book
+    long_count: jnp.ndarray   # [D] factor-weighted long counts
+    short_count: jnp.ndarray  # [D]
+    result: DailyResult
+
+
+def compute_manager_weights(factors: jnp.ndarray, settings: SimulationSettings):
+    """Per-factor daily weight books: ``[M, D, N]`` shifted weights plus
+    ``[M, D]`` leg counts (reference ``compute_manager_weights`` per factor,
+    vmapped over the manager axis)."""
+    def one(signal):
+        return daily_trade_list(signal, settings)
+
+    return jax.vmap(one)(factors)
+
+
+def compute_multimanager_weights(factors: jnp.ndarray,
+                                 factor_weights: jnp.ndarray,
+                                 settings: SimulationSettings):
+    """Combine manager books with daily factor weights
+    (``multi_manager.py:32-81``).
+
+    Args:
+      factors: ``float[M, D, N]`` manager signals (already investability-
+        masked if desired; the reference passes raw factor columns).
+      factor_weights: ``float[D, M]`` daily factor weights.
+
+    Returns (combined weights [D, N], long_count [D], short_count [D]).
+    """
+    books, lc, sc = compute_manager_weights(factors, settings)
+    fw = factor_weights.T[:, :, None]  # [M, D, 1]
+    # skip zero-weight managers entirely (their NaNs must not propagate)
+    term = jnp.where(fw == 0.0, 0.0, fw * books)
+    combined = term.sum(axis=0)
+    lc_c = (factor_weights.T * lc).sum(axis=0)
+    sc_c = (factor_weights.T * sc).sum(axis=0)
+    return combined, lc_c, sc_c
+
+
+def run_multimanager_backtest(factors: jnp.ndarray, factor_weights: jnp.ndarray,
+                              settings: SimulationSettings) -> MultiManagerOutput:
+    """Combined book -> P&L (``multi_manager.py:84-100``; the combined
+    weights are already shifted by the per-manager pass, so no second lag)."""
+    combined, lc, sc = compute_multimanager_weights(factors, factor_weights,
+                                                    settings)
+    result = daily_portfolio_returns(combined, settings)
+    return MultiManagerOutput(weights=combined, long_count=lc, short_count=sc,
+                              result=result)

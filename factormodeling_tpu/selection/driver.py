@@ -50,19 +50,22 @@ def build_selection_context(factors: jnp.ndarray, returns: jnp.ndarray,
     # The reference applies its second exposure shift INSIDE the window slice
     # (factor_selector.py:84 then :33), so the slice's first date has all-NaN
     # exposures and contributes no pairs: a window of W dates aggregates only
-    # its last W-1 dates of double-shifted stats.
+    # its last W-1 dates of double-shifted stats. Exact for dense universes
+    # (tested); a ragged universe diverges for symbols whose presence gap
+    # straddles a window start — the in-slice shift NaNs their first in-window
+    # observation while the whole-sample masked shift keeps it, a known,
+    # documented approximation (exactness would force the reference's own
+    # O(D*W*F) per-window recompute back in).
     rm = rolling_metrics(daily, max(window - 1, 1))
     # selectors for date i read the window ending at i-1 (today excluded)
     metrics_win = {k: shift(v, 1, axis=-1) for k, v in rm.items()}
 
     ok = ~jnp.isnan(factor_ret)
     sums = rolling_sum(jnp.where(ok, factor_ret, 0.0), window, axis=0)
-    cnts = rolling_sum(ok.astype(factor_ret.dtype), window, axis=0)
     return SelectionContext(
         metrics_win=metrics_win,
         factor_ret=factor_ret,
         ret_win_sum=shift(sums, 1, axis=0, fill_value=0.0),
-        ret_win_cnt=shift(cnts, 1, axis=0, fill_value=0.0),
         window=window,
     )
 

@@ -22,7 +22,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from factormodeling_tpu.ops._window import rolling_sum
 from factormodeling_tpu.selection.shrinkage import ledoit_wolf_shrinkage
 from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_dense
 
@@ -51,7 +50,6 @@ class SelectionContext:
     metrics_win: dict      # name -> float[F, D] trailing-window metrics (shifted)
     factor_ret: jnp.ndarray  # float[D, F] per-date factor returns (raw)
     ret_win_sum: jnp.ndarray  # float[D, F] trailing-window NaN-skipping sums (shifted)
-    ret_win_cnt: jnp.ndarray  # float[D, F] trailing-window non-NaN counts (shifted)
     window: int = dataclasses.field(metadata=dict(static=True))
 
 
@@ -110,8 +108,16 @@ def mvo_selector(ctx: SelectionContext, *, risk_aversion: float = 1.0,
         if use_shrinkage:
             cov = ledoit_wolf_shrinkage(win)
         else:
-            c = win - win.mean(axis=0, keepdims=True)
-            cov = (c.T @ c) / (window - 1)
+            # pandas DataFrame.cov(): pairwise-complete over jointly-valid
+            # rows with per-pair means, ddof=1 — NaNs must not poison it
+            valid = (~jnp.isnan(win)).astype(ret.dtype)
+            x0 = jnp.where(jnp.isnan(win), 0.0, win)
+            n_pair = valid.T @ valid
+            sxy = x0.T @ x0
+            sx = x0.T @ valid   # sum of column i over rows where j is valid
+            ns = jnp.where(n_pair > 0, n_pair, jnp.nan)
+            cov = (sxy - sx * sx.T / ns) / jnp.where(n_pair > 1, n_pair - 1.0,
+                                                     jnp.nan)
         cov = 0.5 * (cov + cov.T)
         prob = BoxQPProblem(
             q=-mu, lo=jnp.zeros(f, ret.dtype), hi=jnp.full(f, cap, ret.dtype),

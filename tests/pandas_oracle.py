@@ -340,3 +340,342 @@ def o_rolling_selection(factors_df, returns, factor_ret_df, window, method,
     sel = pd.DataFrame(vecs).T
     sel = sel.div(sel.sum(axis=1), axis=0).fillna(0)
     return sel
+
+
+# ------------------------------------------------------------- composite blend
+
+_SUFFIX_RULES = {
+    "_eq": (10, 90, lambda a, lo, hi: np.where(a <= lo, -1.0, np.where(a >= hi, 1.0, 0.0))),
+    "_flx": (2, 98, lambda a, lo, hi: (np.clip(a, lo, hi) - lo) / (hi - lo) * 2 - 1),
+    "_long": (2, 98, lambda a, lo, hi: (np.clip(a, lo, hi) - lo) / (hi - lo)),
+    "_short": (2, 98, lambda a, lo, hi: (np.clip(a, lo, hi) - hi) / (hi - lo)),
+}
+
+
+def _safe_z(x: pd.Series) -> pd.Series:
+    mu, sd = x.mean(), x.std(ddof=0)
+    if sd == 0 or np.isnan(sd):
+        return pd.Series(0.0, index=x.index)
+    return (x - mu) / sd
+
+
+def o_composite_static(factors_df: pd.DataFrame, selected, method="zscore"):
+    """Reference composite_factor_calculation semantics (per-column suffix
+    percentiles, group-mean proxies, zscore-mean or rank-sum, demean)."""
+    from scipy import stats as sps
+    from collections import defaultdict
+
+    adj = factors_df[selected].copy()
+
+    def prep(day: pd.DataFrame) -> pd.DataFrame:
+        day = day.copy()
+        for sfx, (ql, qh, fn) in _SUFFIX_RULES.items():
+            for c in [c for c in day.columns if c.endswith(sfx)]:
+                arr = day[c].to_numpy(dtype=float)
+                clean = arr[~np.isnan(arr)]
+                if clean.size == 0:
+                    day[c] = 0.0
+                    continue
+                lo, hi = np.nanpercentile(clean, [ql, qh])
+                day[c] = 0.0 if hi == lo else fn(arr, lo, hi)
+        return day
+
+    adj = adj.groupby(level="date", group_keys=False).apply(prep)
+
+    groups = defaultdict(list)
+    for c in selected:
+        groups[c.split("_", 1)[0]].append(c)
+    proxies = pd.DataFrame({f"group_{p}": adj[cs].mean(axis=1)
+                            for p, cs in groups.items()}, index=factors_df.index)
+
+    if method == "zscore":
+        normed = proxies.groupby(level="date").transform(_safe_z)
+        comp = normed.mean(axis=1)
+    else:
+        normed = proxies.groupby(level="date").transform(
+            lambda x: (sps.rankdata(x) - 1) / (len(x) - 1))
+        comp = normed.sum(axis=1)
+    return comp.groupby(level="date").transform(lambda x: x - x.mean())
+
+
+def o_composite_weighted(factors_df: pd.DataFrame, selection_df: pd.DataFrame,
+                         method="zscore"):
+    """Reference weighted_composite_factor semantics (pooled suffix
+    percentiles, weight>0 filter, group-weight renorm, fillna(0))."""
+    from scipy import stats as sps
+    from collections import defaultdict
+
+    pieces = []
+    for date, weights in selection_df.iterrows():
+        chosen = weights[weights > 0].index.tolist()
+        day = factors_df.loc[date]
+        if not chosen or len(day) == 0:
+            continue
+        day = day[chosen].copy()
+        for sfx, (ql, qh, fn) in _SUFFIX_RULES.items():
+            cols = [c for c in day.columns if c.endswith(sfx)]
+            if not cols:
+                continue
+            vals = day[cols].to_numpy(dtype=float)
+            clean = vals[~np.isnan(vals)]
+            if clean.size == 0:
+                day[cols] = 0.0
+                continue
+            lo, hi = np.nanpercentile(clean, [ql, qh])
+            if lo == hi:
+                day[cols] = 0.0
+            else:
+                for c in cols:
+                    day[c] = fn(day[c].to_numpy(dtype=float), lo, hi)
+
+        groups = defaultdict(list)
+        for c in chosen:
+            groups[c.split("_", 1)[0]].append(c)
+        proxies = pd.DataFrame({f"group_{p}": day[cs].mean(axis=1)
+                                for p, cs in groups.items()}, index=day.index)
+        gw = {f"group_{p}": float(weights[cs].sum()) for p, cs in groups.items()}
+        tot = sum(gw.values())
+        if tot > 0:
+            gw = {k: v / tot for k, v in gw.items()}
+        else:
+            gw = {k: 1.0 / len(gw) for k in gw}
+
+        if method == "zscore":
+            normed = proxies.apply(_safe_z, axis=0)
+        else:
+            normed = proxies.apply(
+                lambda x: pd.Series((sps.rankdata(x) - 1) / (len(x) - 1), index=x.index),
+                axis=0)
+        comp = sum(normed[c] * gw[c] for c in proxies.columns)
+        comp = comp - comp.mean()
+        comp.index = pd.MultiIndex.from_product([[date], comp.index],
+                                                names=["date", "symbol"])
+        pieces.append(comp)
+    out = pd.concat(pieces)
+    return out.reindex(factors_df.index).fillna(0)
+
+
+# ------------------------------------------------------------ backtest engine
+
+def _o_normalize_legs(w: pd.Series) -> pd.Series:
+    wp, wn = w.clip(lower=0), w.clip(upper=0)
+    if wp.sum() > 0:
+        wp = wp / wp.sum()
+    if wn.sum() < 0:
+        wn = wn / -wn.sum()
+    return wp + wn
+
+
+def _o_cap_redistribute(w: pd.Series, mw: float, max_iter=10, tol=1e-6) -> pd.Series:
+    for _ in range(max_iter):
+        capped = w.clip(lower=-mw, upper=mw)
+        le = 1 - capped[capped > 0].sum()
+        se = -1 - capped[capped < 0].sum()
+        ul = capped[(w > 0) & (capped < mw)]
+        us = capped[(w < 0) & (capped > -mw)]
+        if (abs(le) < tol and abs(se) < tol) or (ul.empty and us.empty):
+            break
+        if not ul.empty and abs(le) > tol:
+            capped.loc[ul.index] += le * ul / ul.sum()
+        if not us.empty and abs(se) > tol:
+            capped.loc[us.index] += se * us / us.sum()
+        w = capped
+    return w.clip(lower=-mw, upper=mw)
+
+
+def o_daily_trade_list(signal: pd.Series, method: str, *, pct=0.1, max_weight=0.03,
+                       returns: pd.Series | None = None, lookback=60,
+                       shrink=0.1, turnover_penalty=0.1, return_weight=0.0):
+    """Reference _daily_trade_list semantics (equal / linear / mvo /
+    mvo_turnover with a scipy QP standing in for OSQP)."""
+    from scipy.optimize import minimize
+
+    rows, counts = [], []
+    for date, grp in signal.groupby(level="date"):
+        x = grp.droplevel("date")
+        pos, neg = x[x > 0], x[x < 0]
+        if pos.empty or neg.empty or (method.startswith("mvo") and len(x) < 2):
+            w = pd.Series(0.0, index=x.index)
+            counts.append({"date": date, "long_count": 0, "short_count": 0})
+            rows.append(w.to_frame("w").assign(date=date))
+            continue
+
+        if method == "equal":
+            kl = max(int(np.floor(len(pos) * pct)), 1)
+            ks = max(int(np.floor(len(neg) * pct)), 1)
+            w = pd.Series(0.0, index=x.index)
+            w[pos.sort_values(ascending=False).iloc[:kl].index] = 1.0
+            w[neg.sort_values().iloc[:ks].index] = -1.0
+            w = _o_normalize_legs(w)
+            counts.append({"date": date, "long_count": kl, "short_count": ks})
+        elif method == "linear":
+            w = pd.Series(0.0, index=x.index)
+            w[pos.index], w[neg.index] = pos, neg
+            w = _o_cap_redistribute(_o_normalize_legs(w), max_weight)
+            counts.append({"date": date, "long_count": len(pos), "short_count": len(neg)})
+        else:
+            hist = returns[returns.index.get_level_values("date") < date]
+            dates_prior = sorted(hist.index.get_level_values("date").unique())
+            if len(dates_prior) == 0:
+                kl = max(int(np.floor(len(pos) * pct)), 1)
+                ks = max(int(np.floor(len(neg) * pct)), 1)
+                w = pd.Series(0.0, index=x.index)
+                w[pos.sort_values(ascending=False).iloc[:kl].index] = 1.0
+                w[neg.sort_values().iloc[:ks].index] = -1.0
+                w = _o_normalize_legs(w)
+                counts.append({"date": date, "long_count": kl, "short_count": ks})
+                rows.append(w.to_frame("w").assign(date=date))
+                continue
+            start = dates_prior[-lookback] if len(dates_prior) >= lookback else dates_prior[0]
+            win = hist[hist.index.get_level_values("date") >= start]
+            mat = win.unstack("symbol").fillna(0)
+            for sym in x.index:
+                if sym not in mat.columns:
+                    mat[sym] = 0.0
+            mat = mat[list(x.index)]
+            if mat.shape[0] < 2:
+                cov = np.full((len(x), len(x)), np.nan)  # 1-row sample cov
+            else:
+                cov = mat.cov().to_numpy().copy()
+            np.fill_diagonal(cov, np.diag(cov) + 1e-6)
+            if shrink > 0:
+                cov = (1 - shrink) * cov + shrink * np.mean(np.diag(cov)) * np.eye(len(cov))
+            pmask, nmask = (x > 0).to_numpy(), (x < 0).to_numpy()
+            x0 = np.zeros(len(x))
+            x0[pmask] = 1.0 / pmask.sum()
+            x0[nmask] = -1.0 / nmask.sum()
+            prev = rows[-1]["w"].reindex(x.index).fillna(0.0).to_numpy() if rows else np.zeros(len(x))
+
+            if np.isnan(cov).any():
+                w = pd.Series(x0, index=x.index)
+            else:
+                if method == "mvo":
+                    def obj(wv):
+                        return wv @ cov @ wv
+                else:
+                    def obj(wv):
+                        return (wv @ cov @ wv + turnover_penalty * np.abs(wv - prev).sum()
+                                - return_weight * (x.to_numpy() @ wv))
+                cons = [{"type": "eq", "fun": lambda wv: wv[pmask].sum() - 1},
+                        {"type": "eq", "fun": lambda wv: wv[nmask].sum() + 1}]
+                bounds = [(0, max_weight) if p else ((-max_weight, 0) if m else (0, 0))
+                          for p, m in zip(pmask, nmask)]
+                res = minimize(obj, x0, method="SLSQP", bounds=bounds, constraints=cons,
+                               options={"maxiter": 1000, "ftol": 1e-12})
+                w = pd.Series(res.x if res.success else x0, index=x.index)
+                if method == "mvo_turnover" and res.success:
+                    pruned = w.mask(w.abs() < 1e-6, 0.0)
+                    ld, sd = pruned[pmask].sum(), -pruned[nmask].sum()
+                    if ld > 0 and sd > 0:
+                        w = pd.Series(0.0, index=x.index)
+                        w[pmask] = pruned[pmask] / ld
+                        w[nmask] = pruned[nmask] / sd
+            counts.append({"date": date, "long_count": int(pmask.sum()),
+                           "short_count": int(nmask.sum())})
+        rows.append(w.to_frame("w").assign(date=date))
+
+    stacked = pd.concat(rows)
+    stacked = stacked.set_index("date", append=True)["w"].swaplevel().sort_index()
+    stacked.index.names = ["date", "symbol"]
+    shifted = stacked.groupby(level="symbol").shift(1)
+    return shifted, pd.DataFrame(counts).set_index("date")
+
+
+def o_daily_portfolio_returns(weights: pd.Series, returns: pd.Series,
+                              cap_flag: pd.Series, transaction_cost=True):
+    """Reference _daily_portfolio_returns semantics on wide frames."""
+    w_df = weights.unstack().fillna(0)
+    r_df = returns.unstack().fillna(0)
+    longs = w_df.clip(lower=0)
+    shorts = w_df.clip(upper=0).abs()
+    long_raw = (longs * r_df).sum(axis=1)
+    short_raw = -(shorts * r_df).sum(axis=1)
+    lt = longs.diff().abs().sum(axis=1)
+    st = shorts.diff().abs().sum(axis=1)
+    rate_map = {1: 0.0025, 2: 0.0015, 3: 0.0010}
+    rates = cap_flag.unstack().fillna(0).astype(int).map(lambda v: rate_map.get(v, 0.0))
+    l_cost = (longs.diff().abs() * rates).sum(axis=1)
+    s_cost = (shorts.diff().abs() * rates).sum(axis=1)
+    if transaction_cost:
+        long_ret, short_ret = long_raw - l_cost, short_raw - s_cost
+    else:
+        long_ret, short_ret = long_raw, short_raw
+    return pd.DataFrame({
+        "log_return": long_ret + short_ret,
+        "long_return": long_ret, "short_return": short_ret,
+        "long_turnover": lt, "short_turnover": st, "turnover": lt + st,
+    })
+
+
+# ------------------------------------------------------- analytics / managers
+
+def o_analyzer_metrics(result_df: pd.DataFrame, trading_days=252) -> dict:
+    """Reference PortfolioAnalyzer metric semantics (portfolio_analyzer.py)."""
+    df = result_df.copy()
+    df["date"] = pd.to_datetime(df["date"])
+    df = df.sort_values("date").reset_index(drop=True)
+    ret = np.exp(df["log_return"]) - 1
+    cum = (1 + ret).cumprod() - 1
+    total_years = (df["date"].iloc[-1] - df["date"].iloc[0]).days / 365.25
+    ann = (cum.iloc[-1] + 1) ** (1 / total_years) - 1
+    sharpe = ret.mean() / ret.std() * np.sqrt(trading_days)
+    downside = ret[ret < 0].std()
+    peak = (cum + 1).cummax()
+    return {
+        "average_return": ret.mean(),
+        "daily_volatility": ret.std(),
+        "annualized_return": ann,
+        "sharpe": sharpe,
+        "sortino": ret.mean() / downside * np.sqrt(trading_days),
+        "max_drawdown": ((cum + 1) / peak - 1).min(),
+        "monthly": ret.groupby(df["date"].dt.to_period("M")).apply(
+            lambda x: (1 + x).prod() - 1),
+    }
+
+
+def o_quantile_backtest_log(feature: pd.Series, returns: pd.Series,
+                            n_groups=5) -> pd.DataFrame:
+    """Reference quantile_backtest_log (composite_factor.py:63-89)."""
+    lbl0 = (feature.groupby(level="date")
+            .transform(lambda x: pd.qcut(x.rank(method="first"), n_groups,
+                                         labels=False, duplicates="drop")))
+    q = (n_groups - lbl0).astype("Int64")
+    q1 = q.groupby(level="symbol").shift(1)
+    df = pd.DataFrame({"log_ret": returns, "group": q1}).dropna(
+        subset=["group", "log_ret"])
+    grp = (df.reset_index().groupby(["date", "group"])["log_ret"].mean()
+           .unstack(level="group").sort_index())
+    return grp.reindex(columns=range(1, n_groups + 1))
+
+
+def o_multimanager(factors_df: pd.DataFrame, factor_weights: pd.DataFrame,
+                   method="equal", pct=0.1, max_weight=0.03):
+    """Reference compute_multimanager_weights loop (multi_manager.py:32-81)."""
+    mgr_w, mgr_c = {}, {}
+    for fac in factor_weights.columns:
+        w, c = o_daily_trade_list(factors_df[fac].dropna(), method,
+                                  pct=pct, max_weight=max_weight)
+        mgr_w[fac], mgr_c[fac] = w, c
+    all_symbols = factors_df.index.get_level_values("symbol").unique()
+    combined, counts = [], []
+    for date in factor_weights.index:
+        daily = pd.Series(0.0, index=all_symbols)
+        lc = sc = 0.0
+        for fac, fw in factor_weights.loc[date].items():
+            if fw == 0 or fac not in mgr_w:
+                continue
+            try:
+                today = mgr_w[fac].xs(date, level="date")
+                ctoday = mgr_c[fac].loc[date]
+            except (KeyError, IndexError):
+                continue
+            daily = daily.add(today * fw, fill_value=0)
+            lc += fw * ctoday["long_count"]
+            sc += fw * ctoday["short_count"]
+        daily.index = pd.MultiIndex.from_product([[date], daily.index],
+                                                 names=["date", "symbol"])
+        combined.append(daily)
+        counts.append({"date": date, "long_count": lc, "short_count": sc})
+    final = pd.concat(combined)
+    final = final[final != 0]
+    return final, pd.DataFrame(counts).set_index("date")

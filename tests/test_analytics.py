@@ -1,0 +1,112 @@
+"""Analyzer metrics, quantile backtests, and multimanager vs oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from factormodeling_tpu.analytics import (
+    PortfolioAnalyzer,
+    plot_factor_distributions,
+    plot_full_performance,
+    plot_quantile_backtests,
+    quantile_backtest_log,
+)
+from factormodeling_tpu.backtest import SimulationSettings
+from factormodeling_tpu.multimanager import run_multimanager_backtest
+from tests import pandas_oracle as po
+
+D, N = 260, 10
+
+
+def make_result(rng):
+    dates = np.datetime64("2021-01-04") + np.arange(D) * np.timedelta64(1, "D")
+    log_ret = rng.normal(0.0005, 0.01, size=D)
+    return dates, {
+        "log_return": log_ret,
+        "long_return": log_ret * 0.6,
+        "short_return": log_ret * 0.4,
+        "long_turnover": np.abs(rng.normal(0.1, 0.02, size=D)),
+        "short_turnover": np.abs(rng.normal(0.1, 0.02, size=D)),
+        "turnover": np.abs(rng.normal(0.2, 0.04, size=D)),
+    }
+
+
+def test_analyzer_matches_oracle(rng):
+    dates, cols = make_result(rng)
+    a = PortfolioAnalyzer(cols, dates)
+    exp = po.o_analyzer_metrics(pd.DataFrame({"date": dates, **cols}))
+    assert np.isclose(a.average_return(), exp["average_return"])
+    assert np.isclose(a.daily_volatility(), exp["daily_volatility"])
+    assert np.isclose(a.annualized_return(), exp["annualized_return"])
+    assert np.isclose(a.sharpe_ratio(), exp["sharpe"])
+    assert np.isclose(a.sortino_ratio(), exp["sortino"])
+    assert np.isclose(a.max_drawdown(), exp["max_drawdown"])
+    _, monthly = a.monthly_return()
+    np.testing.assert_allclose(monthly, exp["monthly"].to_numpy(), atol=1e-12)
+    s = a.summary()
+    assert set(s) == {"Average Daily Return", "Annualized Return",
+                      "Yearly Volatility", "Max Daily Return", "Sharpe Ratio",
+                      "Sortino Ratio", "Max Drawdown", "Min Daily Return"}
+
+
+def test_quantile_backtest_matches_oracle(rng):
+    d, n = 30, 40
+    feature = rng.normal(size=(d, n))
+    feature[rng.uniform(size=(d, n)) < 0.1] = np.nan
+    returns = rng.normal(scale=0.02, size=(d, n))
+    qb = quantile_backtest_log(jnp.array(feature), jnp.array(returns), 5)
+    exp = po.o_quantile_backtest_log(po.dense_to_long(feature),
+                                     po.dense_to_long(returns), 5)
+    got = np.asarray(qb.group_log)
+    exp_arr = np.full((d, 5), np.nan)
+    for date, row in exp.iterrows():
+        exp_arr[int(date)] = row.to_numpy(dtype=float, na_value=np.nan)
+    np.testing.assert_allclose(got, exp_arr, atol=1e-10, equal_nan=True)
+    # spread = bucket1 - bucket5
+    np.testing.assert_allclose(np.asarray(qb.spread_log),
+                               exp_arr[:, 0] - exp_arr[:, 4], atol=1e-10,
+                               equal_nan=True)
+
+
+def test_multimanager_matches_oracle(rng):
+    d, n, m = 12, 9, 3
+    factors = rng.normal(size=(m, d, n))
+    returns = rng.normal(scale=0.02, size=(d, n))
+    cap = np.ones((d, n))
+    fw = rng.uniform(size=(d, m)) * (rng.uniform(size=(d, m)) > 0.3)
+    fdf = pd.DataFrame({f"fac{i}": po.dense_to_long(factors[i]) for i in range(m)})
+    fw_df = pd.DataFrame(fw, index=pd.RangeIndex(d), columns=[f"fac{i}" for i in range(m)])
+
+    s = SimulationSettings(returns=jnp.array(returns), cap_flag=jnp.array(cap),
+                           investability_flag=jnp.ones((d, n)), method="equal",
+                           pct=0.3)
+    out = run_multimanager_backtest(jnp.array(factors), jnp.array(fw), s)
+    exp_w, exp_counts = po.o_multimanager(fdf, fw_df, method="equal", pct=0.3)
+    got = np.nan_to_num(np.asarray(out.weights))
+    exp_dense = po.long_to_dense(exp_w, d, n)
+    np.testing.assert_allclose(got, np.nan_to_num(exp_dense), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(out.long_count),
+                               exp_counts["long_count"].to_numpy(), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(out.short_count),
+                               exp_counts["short_count"].to_numpy(), atol=1e-9)
+
+
+def test_plots_render_headless(rng, tmp_path):
+    dates, cols = make_result(rng)
+    a = PortfolioAnalyzer(cols, dates)
+    counts = (dates, np.full(D, 3.0), np.full(D, 3.0))
+    fig = plot_full_performance(a, counts)
+    fig.savefig(tmp_path / "dash.png")
+
+    factors = rng.normal(size=(4, 20, 30))
+    fig2 = plot_factor_distributions(factors, [f"f{i}" for i in range(4)])
+    fig2.savefig(tmp_path / "dist.png")
+
+    feature = rng.normal(size=(40, 25))
+    rets = rng.normal(scale=0.02, size=(40, 25))
+    qb = quantile_backtest_log(jnp.array(feature), jnp.array(rets), 5)
+    fig3 = plot_quantile_backtests({"alpha": qb},
+                                   np.arange(40), 5)
+    fig3.savefig(tmp_path / "quant.png")
+    assert (tmp_path / "dash.png").stat().st_size > 10000

@@ -1,0 +1,178 @@
+"""Backtest engine vs the pandas/scipy oracle, plus simulator invariants the
+reference only warns about (SURVEY.md section 4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu.backtest import (
+    SimulationSettings,
+    daily_trade_list,
+    run_simulation,
+)
+from tests import pandas_oracle as po
+
+D, N = 16, 12
+
+
+def make_market(rng, nan_frac=0.1):
+    returns = rng.normal(scale=0.02, size=(D, N))
+    returns[rng.uniform(size=(D, N)) < nan_frac] = np.nan
+    cap = rng.integers(1, 4, size=(D, N)).astype(float)
+    invest = np.ones((D, N))
+    invest[rng.uniform(size=(D, N)) < 0.05] = 0.0
+    signal = rng.normal(size=(D, N))
+    signal[rng.uniform(size=(D, N)) < nan_frac] = np.nan
+    signal[3] = np.abs(signal[3])  # a long-only day -> flat
+    return returns, cap, invest, signal
+
+
+def settings_for(returns, cap, invest, **kw):
+    return SimulationSettings(returns=jnp.array(returns), cap_flag=jnp.array(cap),
+                              investability_flag=jnp.array(invest), **kw)
+
+
+def run_oracle(signal, returns, cap, invest, method, **kw):
+    sig = po.dense_to_long(signal * invest)
+    w, counts = po.o_daily_trade_list(sig, method, returns=po.dense_to_long(returns), **kw)
+    res = po.o_daily_portfolio_returns(w, po.dense_to_long(returns),
+                                       po.dense_to_long(cap))
+    return w, counts, res
+
+
+@pytest.mark.parametrize("method,kw", [
+    ("equal", dict(pct=0.3)),
+    ("linear", dict(max_weight=0.25)),
+])
+def test_schemes_match_oracle(rng, method, kw):
+    returns, cap, invest, signal = make_market(rng)
+    s = settings_for(returns, cap, invest, method=method, **kw)
+    out = run_simulation(jnp.array(signal), s)
+
+    w_exp, counts_exp, res_exp = run_oracle(signal, returns, cap, invest, method, **kw)
+    w_got = np.asarray(out.weights)
+    np.testing.assert_allclose(w_got, po.long_to_dense(w_exp, D, N),
+                               atol=1e-9, equal_nan=True)
+    np.testing.assert_array_equal(np.asarray(out.long_count),
+                                  counts_exp["long_count"].to_numpy())
+    np.testing.assert_array_equal(np.asarray(out.short_count),
+                                  counts_exp["short_count"].to_numpy())
+    for col in ["log_return", "long_return", "short_return",
+                "long_turnover", "short_turnover", "turnover"]:
+        np.testing.assert_allclose(np.asarray(getattr(out.result, col)),
+                                   res_exp[col].to_numpy(), atol=1e-9, err_msg=col)
+
+
+def test_mvo_matches_oracle(rng):
+    returns, cap, invest, signal = make_market(rng, nan_frac=0.0)
+    s = settings_for(returns, cap, invest, method="mvo", max_weight=0.5,
+                     lookback_period=6, qp_iters=3000, mvo_batch=8)
+    out = run_simulation(jnp.array(signal), s)
+    w_exp, counts_exp, _ = run_oracle(signal, returns, cap, invest, "mvo",
+                                      shrink=0.1, max_weight=0.5, lookback=6)
+    w_got = np.asarray(out.weights)
+    exp = po.long_to_dense(w_exp, D, N)
+    # smooth QP: both solvers sit at the unique optimum
+    np.testing.assert_allclose(np.nan_to_num(w_got), np.nan_to_num(exp), atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(out.long_count),
+                                  counts_exp["long_count"].to_numpy())
+
+
+def test_mvo_turnover_beats_or_matches_oracle_objective(rng):
+    """The L1 turnover objective is nonsmooth; scipy SLSQP (the oracle's
+    stand-in for OSQP) stalls at kink points, so weight-level equality is the
+    wrong acceptance bar (SURVEY.md section 7, 'QP parity'). Instead: on every
+    date, our solution must score at least as well on the reference's own
+    objective w'Sigma w + tp*|w - prev|_1 (evaluated with our prev), and
+    respect the constraint set exactly."""
+    lam, tp, lookback = 0.1, 0.1, 6
+    returns, cap, invest, signal = make_market(rng, nan_frac=0.0)
+    masked = signal * invest
+    s = settings_for(returns, cap, invest, method="mvo_turnover", max_weight=0.5,
+                     lookback_period=lookback, qp_iters=3000, mvo_batch=8)
+    out = run_simulation(jnp.array(signal), s)
+    w_shift = np.asarray(out.weights)
+    w_unshift = np.vstack([w_shift[1:], np.zeros((1, N))])  # undo the 1-day lag
+    w_exp_l, counts_exp = po.o_daily_trade_list(
+        po.dense_to_long(masked), "mvo_turnover",
+        returns=po.dense_to_long(returns), max_weight=0.5, lookback=lookback,
+        shrink=lam, turnover_penalty=tp)
+    exp_shift = po.long_to_dense(w_exp_l, D, N)
+    exp_unshift = np.vstack([exp_shift[1:], np.zeros((1, N))])
+
+    checked = 0
+    for d in range(2, D - 1):
+        hist = returns[max(0, d - lookback):d]
+        if hist.shape[0] < 2:
+            continue
+        cov = np.cov(hist, rowvar=False, ddof=1)
+        np.fill_diagonal(cov, np.diag(cov) + 1e-6)
+        cov = (1 - lam) * cov + lam * np.mean(np.diag(cov)) * np.eye(N)
+        prev = w_unshift[d - 1]
+        mine, ora = w_unshift[d], exp_unshift[d]
+        if not (np.abs(mine).sum() > 0 and np.abs(ora).sum() > 0):
+            continue
+        obj = lambda w: w @ cov @ w + tp * np.abs(w - prev).sum()
+        assert obj(mine) <= obj(ora) + 1e-6, f"date {d}"
+        pos, neg = masked[d] > 0, masked[d] < 0
+        np.testing.assert_allclose(mine[pos].sum(), 1.0, atol=1e-8)
+        np.testing.assert_allclose(mine[neg].sum(), -1.0, atol=1e-8)
+        pinned = ~pos & ~neg
+        if pinned.any():
+            assert np.abs(mine[pinned]).max() < 1e-8
+        checked += 1
+    assert checked >= 8
+    np.testing.assert_array_equal(np.asarray(out.long_count),
+                                  counts_exp["long_count"].to_numpy())
+
+
+def test_invariants_legs_cap_lag(rng):
+    """Properties the reference only warns about: leg sums +-1, |w| <= cap,
+    zero-signal names stay at zero, weights lag the signal by one day."""
+    returns, cap, invest, signal = make_market(rng)
+    s = settings_for(returns, cap, invest, method="linear", max_weight=0.2)
+    out = run_simulation(jnp.array(signal), s)
+    w = np.asarray(out.weights)[1:]  # row 0 is the pre-history NaN row
+    sig = (signal * invest)[:-1]     # yesterday's signal
+    live = ~np.isnan(w).any(axis=1) & (np.abs(w).sum(axis=1) > 0)
+    assert live.any()
+    # when the cap binds (count * max_weight < 1) the leg can only reach
+    # count * max_weight — the reference clips the same way
+    cp = (sig[live] > 0).sum(axis=1)
+    cn = (sig[live] < 0).sum(axis=1)
+    np.testing.assert_allclose(np.where(w[live] > 0, w[live], 0).sum(axis=1),
+                               np.minimum(1.0, cp * 0.2), atol=1e-6)
+    np.testing.assert_allclose(np.where(w[live] < 0, w[live], 0).sum(axis=1),
+                               -np.minimum(1.0, cn * 0.2), atol=1e-6)
+    assert np.nanmax(np.abs(w)) <= 0.2 + 1e-9
+    dead = ~(sig > 0) & ~(sig < 0)
+    assert np.abs(np.where(dead, np.nan_to_num(w), 0.0)).max() == 0.0
+
+
+def test_transaction_costs_reduce_returns(rng):
+    returns, cap, invest, signal = make_market(rng)
+    base = settings_for(returns, cap, invest, method="equal", transaction_cost=False)
+    costed = settings_for(returns, cap, invest, method="equal", transaction_cost=True)
+    r0 = run_simulation(jnp.array(signal), base).result
+    r1 = run_simulation(jnp.array(signal), costed).result
+    diff = np.asarray(r0.log_return) - np.asarray(r1.log_return)
+    assert (diff >= -1e-12).all() and diff.max() > 0
+
+
+def test_all_flat_signal_is_flat_everywhere(rng):
+    returns, cap, invest, _ = make_market(rng)
+    s = settings_for(returns, cap, invest, method="equal")
+    out = run_simulation(jnp.zeros((D, N)), s)
+    np.testing.assert_array_equal(np.nan_to_num(np.asarray(out.weights)), 0.0)
+    np.testing.assert_array_equal(np.asarray(out.result.log_return), 0.0)
+
+
+def test_jit_end_to_end(rng):
+    import jax
+    returns, cap, invest, signal = make_market(rng)
+    s = settings_for(returns, cap, invest, method="linear")
+    fast = jax.jit(run_simulation)
+    out = fast(jnp.array(signal), s)
+    out2 = run_simulation(jnp.array(signal), s)
+    np.testing.assert_allclose(np.asarray(out.weights), np.asarray(out2.weights),
+                               atol=1e-12, equal_nan=True)

@@ -1,0 +1,74 @@
+"""Composite blend vs pandas oracle (static and weighted, zscore and rank)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from factormodeling_tpu.composite import composite_static, composite_weighted
+from tests import pandas_oracle as po
+
+D, N = 14, 16
+NAMES = ["mom_eq", "mom_flx", "val_long", "val_short", "qual_flx", "size"]
+F = len(NAMES)
+
+
+def make_stack(rng, nan_frac=0.12):
+    factors = rng.normal(size=(F, D, N))
+    factors[rng.uniform(size=factors.shape) < nan_frac] = np.nan
+    factors[0, 3, :] = np.nan  # a suffix column with no data that day
+    factors[:, 5, 2] = np.nan  # an asset with an all-NaN proxy day
+    fdf = pd.DataFrame({NAMES[i]: po.dense_to_long(factors[i]) for i in range(F)})
+    return factors, fdf
+
+
+@pytest.mark.parametrize("method", ["zscore", "rank"])
+def test_composite_static(rng, method):
+    factors, fdf = make_stack(rng)
+    got = np.asarray(composite_static(jnp.array(factors), NAMES, method))
+    exp = po.long_to_dense(po.o_composite_static(fdf, NAMES, method), D, N)
+    np.testing.assert_allclose(got, exp, atol=1e-9, equal_nan=True)
+
+
+@pytest.mark.parametrize("method", ["zscore", "rank"])
+def test_composite_static_subset(rng, method):
+    factors, fdf = make_stack(rng)
+    subset = ["mom_eq", "val_long", "size"]
+    idx = [NAMES.index(n) for n in subset]
+    got = np.asarray(composite_static(jnp.array(factors[idx]), subset, method))
+    exp = po.long_to_dense(po.o_composite_static(fdf, subset, method), D, N)
+    np.testing.assert_allclose(got, exp, atol=1e-9, equal_nan=True)
+
+
+def make_selection(rng):
+    sel = rng.uniform(size=(D, F)) * (rng.uniform(size=(D, F)) > 0.35)
+    sel[:2] = 0.0  # dates outside the selection -> zero rows
+    sel[7] = 0.0
+    rowsum = sel.sum(axis=1, keepdims=True)
+    sel = np.where(rowsum > 0, sel / np.where(rowsum > 0, rowsum, 1), 0.0)
+    sel_df = pd.DataFrame(sel, index=pd.RangeIndex(D), columns=NAMES)
+    # oracle loop only sees selection rows, like the reference's selection_df
+    return sel, sel_df[sel_df.sum(axis=1) > 0]
+
+
+@pytest.mark.parametrize("method", ["zscore", "rank"])
+def test_composite_weighted(rng, method):
+    factors, fdf = make_stack(rng)
+    sel, sel_df = make_selection(rng)
+    got = np.asarray(composite_weighted(jnp.array(factors), NAMES,
+                                        jnp.array(sel), method))
+    exp = po.long_to_dense(po.o_composite_weighted(fdf, sel_df, method), D, N)
+    np.testing.assert_allclose(got, exp, atol=1e-9, equal_nan=True)
+
+
+def test_composite_weighted_zero_dates_are_zero(rng):
+    factors, _ = make_stack(rng)
+    sel = np.zeros((D, F))
+    got = np.asarray(composite_weighted(jnp.array(factors), NAMES, jnp.array(sel)))
+    np.testing.assert_array_equal(got, np.zeros((D, N)))
+
+
+def test_bad_method_raises(rng):
+    factors, _ = make_stack(rng)
+    with pytest.raises(ValueError, match="zscore"):
+        composite_static(jnp.array(factors), NAMES, "median")
