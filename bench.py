@@ -1,0 +1,83 @@
+"""Headline benchmark: the mvo_turnover backtest the reference takes hours on.
+
+Reference baseline (BASELINE.md, measured from ``pipeline.ipynb`` cells
+41-44 tqdm streams): the turnover-penalized MVO simulation runs at
+5.17-7.35 s/date on CPU — 6886 s for the notebook's 1332-date sample at its
+best recorded rate. This script runs the same-shape workload (1332 dates x
+1000 assets, lookback 60, the reference's OSQP ``max_iter=100`` iteration
+budget matched by ``qp_iters=100``) through the TPU engine: a ``lax.scan``
+over dates whose body solves the box-QP via low-rank ADMM (Woodbury through
+the 60-row return window), then prints ONE JSON line.
+
+``vs_baseline`` is the speedup factor: reference seconds / measured seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+D, N = 1332, 1000
+LOOKBACK = 60
+BASELINE_SECONDS = 5.17 * D  # best recorded reference rate, BASELINE.md
+
+
+def make_inputs(d: int, n: int, seed: int = 0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    returns = rng.normal(scale=0.02, size=(d, n)).astype(np.float32)
+    cap = rng.integers(1, 4, size=(d, n)).astype(np.float32)
+    invest = np.ones((d, n), dtype=np.float32)
+    signal = rng.normal(size=(d, n)).astype(np.float32)
+    return (jnp.asarray(signal), jnp.asarray(returns), jnp.asarray(cap),
+            jnp.asarray(invest))
+
+
+def main() -> None:
+    import jax
+
+    from factormodeling_tpu.backtest import SimulationSettings, run_simulation
+
+    smoke = "--smoke" in sys.argv
+    d, n = (64, 64) if smoke else (D, N)
+    signal, returns, cap, invest = make_inputs(d, n)
+    settings = SimulationSettings(
+        returns=returns, cap_flag=cap, investability_flag=invest,
+        method="mvo_turnover", lookback_period=LOOKBACK if not smoke else 8,
+        qp_iters=100, max_weight=0.03, turnover_penalty=0.1)
+
+    step = jax.jit(run_simulation)
+
+    # NB: timing fetches the [D] result to host — on tunneled backends
+    # block_until_ready returns before execution finishes, so materializing
+    # a (tiny) output is the only reliable fence.
+    def run():
+        out = step(signal, settings)
+        np.asarray(out.result.log_return)
+        return out
+
+    out = run()  # compile + warm up
+    times = []
+    for _ in range(3 if not smoke else 1):
+        t0 = time.perf_counter()
+        out = run()
+        times.append(time.perf_counter() - t0)
+    elapsed = min(times)
+
+    total = float(np.nansum(np.asarray(out.result.log_return)))
+    assert np.isfinite(total), "backtest produced non-finite P&L"
+
+    print(json.dumps({
+        "metric": f"mvo_turnover_backtest_{d}d_{n}assets_wallclock",
+        "value": round(elapsed, 4),
+        "unit": "s",
+        "vs_baseline": 0.0 if smoke else round(BASELINE_SECONDS / elapsed, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -155,7 +155,7 @@ def plot_factor_distributions(factors, names, exclude=None, bins=50, ncols=3,
     plt = _plt()
     exclude = set(exclude or [])
     keep = [(i, n) for i, n in enumerate(names) if n not in exclude]
-    nrows = math.ceil(len(keep) / ncols)
+    nrows = max(math.ceil(len(keep) / ncols), 1)
     fig, axes = plt.subplots(nrows, ncols, figsize=(figsize[0], figsize[1] * nrows),
                              squeeze=False)
     flat = axes.ravel()
@@ -179,7 +179,7 @@ def plot_quantile_backtests(results: dict, dates, n_groups=5, ncols=2,
     :class:`~factormodeling_tpu.analytics.quantile.QuantileBacktest`."""
     plt = _plt()
     names = list(results)
-    nrows = math.ceil(len(names) / ncols)
+    nrows = max(math.ceil(len(names) / ncols), 1)
     fig, axes = plt.subplots(nrows, ncols, figsize=(figsize[0], figsize[1] * nrows),
                              squeeze=False)
     for idx, name in enumerate(names):

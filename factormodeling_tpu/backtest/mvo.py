@@ -43,7 +43,8 @@ _JITTER = 1e-6
 
 
 def _window_factors(returns: jnp.ndarray, today: jnp.ndarray, lookback: int):
-    """(alpha, C, s, T) of the factored shrunk covariance for one date.
+    """(C, T) of the factored covariance for one date: centered zero-filled
+    window rows and the usable-row count (``_shrunk_terms`` derives alpha/s).
 
     Rows are the (zero-filled) return rows strictly before ``today``, at most
     ``lookback`` of them (``portfolio_simulation.py:315-359``).

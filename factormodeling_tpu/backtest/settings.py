@@ -38,6 +38,9 @@ class SimulationSettings:
     max_weight: float = 0.03
     pct: float = 0.1
     min_universe: int = 1000          # parity only; unused (see module docstring)
+    # parity only: the reference gates its contributor printout on this
+    # (portfolio_simulation.py:792-795); DailyResult always carries the
+    # per-name P&L columns, so there is nothing to switch on-device
     contributor: bool = dataclasses.field(default=False, metadata=dict(static=True))
 
     # MVO knobs

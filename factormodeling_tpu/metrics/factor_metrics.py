@@ -119,7 +119,8 @@ def _t_sf_two_sided(t: jnp.ndarray, df: jnp.ndarray) -> jnp.ndarray:
     return betainc(df / 2.0, 0.5, x)
 
 
-def _nan_mean_std(x: jnp.ndarray, axis: int):
+def nan_mean_std(x: jnp.ndarray, axis: int):
+    """NaN-skipping (mean, std ddof=1, count) along ``axis``; empty -> NaN."""
     ok = ~jnp.isnan(x)
     n = ok.sum(axis=axis).astype(x.dtype)
     ns = jnp.where(n > 0, n, jnp.nan)
@@ -134,9 +135,9 @@ def aggregate_metrics(daily: dict, *, axis: int = -1) -> dict:
     """Aggregate per-date stats into the reference's per-factor metric table
     (``factor_selector.py:50-70``). ``axis`` is the date axis of the [F, D]
     inputs. Returns a dict of ``METRIC_COLUMNS`` -> float[F]."""
-    ic_mean, ic_std, _ = _nan_mean_std(daily["ic"], axis)
-    ric_mean, ric_std, _ = _nan_mean_std(daily["rank_ic"], axis)
-    b_mean, b_std, b_n = _nan_mean_std(daily["factor_return"], axis)
+    ic_mean, ic_std, _ = nan_mean_std(daily["ic"], axis)
+    ric_mean, ric_std, _ = nan_mean_std(daily["rank_ic"], axis)
+    b_mean, b_std, b_n = nan_mean_std(daily["factor_return"], axis)
 
     tstat = b_mean / (b_std / jnp.sqrt(b_n))
     df = b_n - 1.0

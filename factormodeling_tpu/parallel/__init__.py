@@ -1,0 +1,38 @@
+"""Mesh sharding and multi-chip scaling (SURVEY.md sections 2.8, 5.7-5.8).
+
+The reference is single-process pandas with no distributed backend; the
+TPU-native equivalent of "scaling the long axes" is a ``jax.sharding.Mesh``
+over ICI with XLA collectives inserted by the compiler:
+
+- the **date axis** shards the embarrassingly date-parallel stages (factor
+  scoring, composite blending, equal/linear/mvo weight generation, P&L);
+- the **factor axis** shards factor stacks ``[F, D, N]`` for scoring and the
+  manager axis for multi-manager books;
+- the **combo axis** shards the BASELINE 1000-combo sweep, one shard of
+  candidate combos per device over shared (replicated) manager books.
+
+Nothing here hand-schedules communication: shardings are declared on inputs
+and ``jit`` / ``shard_map`` let XLA lower the cross-shard reductions
+(``psum``/halo exchanges for rolling windows) onto ICI.
+"""
+
+from factormodeling_tpu.parallel.mesh import (  # noqa: F401
+    balanced_mesh_shape,
+    make_mesh,
+    panel_sharding,
+    replicated,
+    stack_sharding,
+)
+from factormodeling_tpu.parallel.pipeline import (  # noqa: F401
+    ResearchOutput,
+    ResearchSummary,
+    build_research_step,
+    make_sharded_research_step,
+    result_summary,
+)
+from factormodeling_tpu.parallel.sweep import (  # noqa: F401
+    SweepOutput,
+    combo_weight_matrix,
+    manager_sweep,
+    make_sharded_manager_sweep,
+)

@@ -1,0 +1,72 @@
+"""Device-mesh construction and canonical shardings for panel data.
+
+The framework's arrays have three long axes — dates ``D``, assets ``N``, and
+factors/combos ``F``/``C`` — and the canonical layout keeps the asset axis
+unsharded (cross-sectional kernels reduce over it every date) while dates and
+factors spread over the mesh. At BASELINE scale (200 x 5040 x 5000 f32 ~ 20 GB)
+a factor stack exceeds one chip's HBM, so the ``[F, D, N]`` stack shards both
+leading axes across a 2-D ``("factor", "date")`` mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "balanced_mesh_shape",
+    "make_mesh",
+    "panel_sharding",
+    "stack_sharding",
+    "replicated",
+]
+
+
+def balanced_mesh_shape(n_devices: int, n_axes: int = 2) -> tuple[int, ...]:
+    """Split ``n_devices`` into ``n_axes`` near-balanced integer factors,
+    largest first (8 -> (4, 2); 6 -> (3, 2); primes -> (p, 1))."""
+    shape = [1] * n_axes
+    rem = int(n_devices)
+    # peel prime factors, always assigning to the currently smallest axis
+    f = 2
+    factors = []
+    while f * f <= rem:
+        while rem % f == 0:
+            factors.append(f)
+            rem //= f
+        f += 1
+    if rem > 1:
+        factors.append(rem)
+    for p in sorted(factors, reverse=True):
+        shape[int(np.argmin(shape))] *= p
+    return tuple(sorted(shape, reverse=True))
+
+
+def make_mesh(axis_names: tuple[str, ...] = ("factor", "date"),
+              n_devices: int | None = None,
+              devices=None) -> Mesh:
+    """A mesh over the first ``n_devices`` available devices with a balanced
+    shape. Single-axis names give a flat mesh (the sweep's ``("combo",)``)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    shape = balanced_mesh_shape(len(devices), len(axis_names))
+    grid = np.asarray(devices).reshape(shape)
+    return Mesh(grid, axis_names)
+
+
+def panel_sharding(mesh: Mesh, date_axis: str = "date") -> NamedSharding:
+    """Sharding for a ``[D, N]`` panel: dates sharded, assets local."""
+    return NamedSharding(mesh, PartitionSpec(date_axis, None))
+
+
+def stack_sharding(mesh: Mesh, factor_axis: str = "factor",
+                   date_axis: str | None = "date") -> NamedSharding:
+    """Sharding for an ``[F, D, N]`` stack: factors x dates over the mesh."""
+    return NamedSharding(mesh, PartitionSpec(factor_axis, date_axis, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,157 @@
+"""The end-to-end research step, single-chip or sharded over a device mesh.
+
+This is the framework's "training step": one jittable function covering the
+reference's whole per-experiment pipeline (``pipeline.ipynb`` cells 21-49) —
+
+    factor scoring -> rolling selection -> weighted composite -> backtest
+    (factor_selector.py)  (factor_selector.py)  (composite_factor.py)
+                                                  (portfolio_simulation.py)
+
+— followed by device-side summary reductions. On a mesh, the factor stack
+``[F, D, N]`` shards over ``("factor", "date")``, panels ``[D, N]`` over
+``("date",)``, and XLA inserts the collectives: ``psum``-style reductions when
+the selection layer contracts the factor axis, halo exchanges for the rolling
+windows and 1-day shifts across date-shard boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from factormodeling_tpu.backtest.engine import SimulationOutput, run_simulation
+from factormodeling_tpu.backtest.pnl import DailyResult
+from factormodeling_tpu.backtest.settings import SimulationSettings
+from factormodeling_tpu.composite import composite_weighted
+from factormodeling_tpu.metrics.factor_metrics import nan_mean_std
+from factormodeling_tpu.parallel.mesh import panel_sharding, stack_sharding
+from factormodeling_tpu.selection import rolling_selection
+
+__all__ = [
+    "ResearchSummary",
+    "ResearchOutput",
+    "result_summary",
+    "build_research_step",
+    "make_sharded_research_step",
+]
+
+_ANNUALIZE = 252.0
+
+
+class ResearchSummary(NamedTuple):
+    """Device-side scalars over the backtest result (NaN-aware; the analyzer's
+    host-side ``summary()`` gives the formatted reference table)."""
+
+    total_log_return: jnp.ndarray
+    sharpe: jnp.ndarray
+    ann_volatility: jnp.ndarray
+    mean_turnover: jnp.ndarray
+    hit_rate: jnp.ndarray
+
+
+class ResearchOutput(NamedTuple):
+    selection: jnp.ndarray   # [D, F] daily factor weights
+    signal: jnp.ndarray      # [D, N] composite signal
+    sim: SimulationOutput
+    summary: ResearchSummary
+
+
+def _nan_mean_std(x: jnp.ndarray):
+    return nan_mean_std(x.ravel(), 0)
+
+
+def result_summary(result: DailyResult) -> ResearchSummary:
+    """Summary scalars of a [D]-shaped daily result (simple-return Sharpe via
+    the reference's exp(log_return)-1 conversion, ``portfolio_analyzer.py:18``)."""
+    simple = jnp.expm1(result.log_return)
+    mean, std, n = _nan_mean_std(simple)
+    ok = ~jnp.isnan(simple)
+    t_mean, _, _ = _nan_mean_std(result.turnover)
+    hits = (jnp.where(ok, simple, 0.0) > 0).sum().astype(simple.dtype)
+    return ResearchSummary(
+        total_log_return=jnp.where(ok, result.log_return, 0.0).sum(),
+        sharpe=mean / std * jnp.sqrt(_ANNUALIZE),
+        ann_volatility=std * jnp.sqrt(_ANNUALIZE),
+        mean_turnover=t_mean,
+        hit_rate=hits / jnp.where(n > 0, n, jnp.nan),
+    )
+
+
+def build_research_step(*, names, window: int,
+                        select_method: str = "icir_top",
+                        select_kwargs: dict[str, Any] | None = None,
+                        blend_method: str = "zscore",
+                        sim_kwargs: dict[str, Any] | None = None):
+    """Close the static config over a jittable
+    ``step(factors, returns, factor_ret, cap_flag, investability, universe)``.
+
+    Args (of the returned step):
+      factors: ``float[F, D, N]`` raw exposures, order matching ``names``.
+      returns: ``float[D, N]`` daily log-returns.
+      factor_ret: ``float[D, F]`` precomputed per-date factor returns.
+      cap_flag / investability: ``[D, N]`` panels.
+      universe: ``bool[D, N]`` membership mask.
+    """
+    names = tuple(names)
+    select_kwargs = dict(select_kwargs or {})
+    sim_kwargs = dict(sim_kwargs or {})
+
+    def step(factors, returns, factor_ret, cap_flag, investability,
+             universe) -> ResearchOutput:
+        selection = rolling_selection(
+            factors, returns, factor_ret, window,
+            method=select_method, method_kwargs=select_kwargs,
+            universe=universe)
+        signal = composite_weighted(factors, names, selection,
+                                    method=blend_method, universe=universe)
+        settings = SimulationSettings(
+            returns=returns, cap_flag=cap_flag,
+            investability_flag=investability, universe=universe,
+            **sim_kwargs)
+        sim = run_simulation(signal, settings)
+        return ResearchOutput(selection=selection, signal=signal, sim=sim,
+                              summary=result_summary(sim.result))
+
+    return step
+
+
+def make_sharded_research_step(mesh: Mesh, *, names, window: int,
+                               select_method: str = "icir_top",
+                               select_kwargs: dict[str, Any] | None = None,
+                               blend_method: str = "zscore",
+                               sim_kwargs: dict[str, Any] | None = None,
+                               factor_axis: str = "factor",
+                               date_axis: str = "date"):
+    """Jit the research step over a 2-D mesh with the canonical shardings.
+
+    Returns ``(jitted_step, shard_inputs)`` where ``shard_inputs`` device_puts
+    a raw input tuple onto the mesh with the declared shardings.
+    """
+    f_size = mesh.shape[factor_axis]
+    if len(tuple(names)) % f_size:
+        raise ValueError(
+            f"{len(tuple(names))} factors are not divisible by the mesh's "
+            f"'{factor_axis}' axis ({f_size}); pad the factor stack (unique "
+            f"prefixes, all-NaN exposures) or pick a mesh whose factor axis "
+            f"divides F")
+    step = build_research_step(names=names, window=window,
+                               select_method=select_method,
+                               select_kwargs=select_kwargs,
+                               blend_method=blend_method,
+                               sim_kwargs=sim_kwargs)
+    fs = stack_sharding(mesh, factor_axis, date_axis)           # [F, D, N]
+    ps = panel_sharding(mesh, date_axis)                        # [D, N]
+    frs = NamedSharding(mesh, PartitionSpec(date_axis, factor_axis))  # [D, F]
+    in_shardings = (fs, ps, frs, ps, ps, ps)
+
+    jitted = jax.jit(step, in_shardings=in_shardings)
+
+    def shard_inputs(factors, returns, factor_ret, cap_flag, investability,
+                     universe):
+        args = (factors, returns, factor_ret, cap_flag, investability, universe)
+        return tuple(jax.device_put(a, s) for a, s in zip(args, in_shardings))
+
+    return jitted, shard_inputs

@@ -1,0 +1,122 @@
+"""Candidate-combo sweep: many factor combinations, one backtest each.
+
+BASELINE.json config 5: "multi_manager sweep: 1000 candidate factor combos x
+10yr daily portfolio_simulation". The reference would run
+``run_multimanager_backtest`` a thousand times, each recomputing every
+manager's daily weight book (``multi_manager.py:41-48``).
+
+TPU design: the per-manager books depend only on (factor, settings) — NOT on
+the combo — so they are computed exactly once (``[F, D, N]``, vmapped) and
+every combo reduces to one MXU einsum contraction over the manager axis plus
+a vectorized P&L. Combos shard over a 1-D ``("combo",)`` mesh via
+``shard_map`` (books replicated, no cross-combo communication), and each
+device chunks its local combos through ``lax.map`` to bound the ``[B, D, N]``
+working set.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+from jax import shard_map
+
+from factormodeling_tpu.backtest.pnl import daily_portfolio_returns
+from factormodeling_tpu.backtest.settings import SimulationSettings
+from factormodeling_tpu.multimanager import compute_manager_weights
+from factormodeling_tpu.parallel.pipeline import result_summary
+
+__all__ = ["SweepOutput", "combo_weight_matrix", "manager_sweep",
+           "make_sharded_manager_sweep"]
+
+
+class SweepOutput(NamedTuple):
+    log_return: jnp.ndarray      # [C, D] daily net returns per combo
+    turnover: jnp.ndarray        # [C, D]
+    total_log_return: jnp.ndarray  # [C]
+    sharpe: jnp.ndarray          # [C]
+    mean_turnover: jnp.ndarray   # [C]
+
+
+def combo_weight_matrix(combos, n_factors: int, weights=None) -> jnp.ndarray:
+    """Dense ``float[C, F]`` combo weights from index lists.
+
+    ``combos``: int array ``[C, K]`` of factor indices per candidate;
+    ``weights``: optional ``[C, K]`` per-member weights (default equal 1/K).
+    Duplicate indices accumulate.
+    """
+    combos = np.asarray(combos, dtype=np.int64)
+    c, k = combos.shape
+    if weights is None:
+        w = np.full((c, k), 1.0 / k)
+    else:
+        w = np.asarray(weights, dtype=np.float64)
+    dense = np.zeros((c, n_factors), dtype=np.float64)
+    np.add.at(dense, (np.arange(c)[:, None], combos), w)
+    return jnp.asarray(dense, dtype=jnp.float32)
+
+
+def _combine_and_pnl(books: jnp.ndarray, combo_weights: jnp.ndarray,
+                     settings: SimulationSettings, combo_batch: int) -> SweepOutput:
+    """Contract replicated books ``[F, D, N]`` against local combo weights
+    ``[Cl, F]``; chunked so the working set stays ``[combo_batch, D, N]``."""
+    clean = jnp.nan_to_num(books)
+    # an *active* manager's NaN poisons the combined cell (then zero-filled in
+    # the P&L, multi_manager docstring); an inactive manager's NaN is skipped
+    nan_books = jnp.isnan(books).astype(books.dtype)
+
+    def one_combo(w):  # w: [F]; lax.map vmaps this over combo_batch-sized chunks
+        combined = jnp.einsum("f,fdn->dn", w, clean)
+        hit = jnp.einsum("f,fdn->dn", (w != 0.0).astype(books.dtype), nan_books)
+        combined = jnp.where(hit > 0, jnp.nan, combined)
+        res = daily_portfolio_returns(combined, settings)
+        summ = result_summary(res)
+        return SweepOutput(
+            log_return=res.log_return, turnover=res.turnover,
+            total_log_return=summ.total_log_return, sharpe=summ.sharpe,
+            mean_turnover=summ.mean_turnover)
+
+    return lax.map(one_combo, combo_weights, batch_size=combo_batch)
+
+
+def manager_sweep(factors: jnp.ndarray, combo_weights: jnp.ndarray,
+                  settings: SimulationSettings, *,
+                  combo_batch: int = 8) -> SweepOutput:
+    """Single-device sweep: one book pass, then every combo's backtest."""
+    books, _, _ = compute_manager_weights(factors, settings)
+    return _combine_and_pnl(books, combo_weights, settings, combo_batch)
+
+
+def make_sharded_manager_sweep(mesh: Mesh, *, combo_axis: str = "combo",
+                               combo_batch: int = 8):
+    """Shard the sweep's combo axis over a 1-D mesh.
+
+    Returns a jitted ``sweep(factors, combo_weights, settings) -> SweepOutput``
+    whose per-combo outputs are sharded over ``combo_axis``. ``C`` must be
+    divisible by the mesh size (pad with zero-weight combos otherwise).
+    """
+    spec_combo = PartitionSpec(combo_axis)
+    rep = PartitionSpec()
+
+    def local_sweep(books, combo_weights, settings):
+        return _combine_and_pnl(books, combo_weights, settings, combo_batch)
+
+    sharded = shard_map(
+        local_sweep, mesh=mesh,
+        in_specs=(rep, PartitionSpec(combo_axis, None), rep),
+        out_specs=SweepOutput(
+            log_return=PartitionSpec(combo_axis, None),
+            turnover=PartitionSpec(combo_axis, None),
+            total_log_return=spec_combo, sharpe=spec_combo,
+            mean_turnover=spec_combo))
+
+    @jax.jit
+    def sweep(factors, combo_weights, settings):
+        books, _, _ = compute_manager_weights(factors, settings)
+        return sharded(books, combo_weights, settings)
+
+    return sweep

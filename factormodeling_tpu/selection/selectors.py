@@ -104,6 +104,10 @@ def mvo_selector(ctx: SelectionContext, *, risk_aversion: float = 1.0,
     def solve_one(today_idx):
         start = jnp.maximum(today_idx - window, 0)
         win = lax.dynamic_slice(ret, (start, 0), (window, f))  # [W, F]
+        # today and later rows never enter the trailing window (the clamped
+        # start would otherwise leak same-day/future returns for early dates)
+        in_past = (start + jnp.arange(window)) < today_idx
+        win = jnp.where(in_past[:, None], win, jnp.nan)
         mu = jnp.nanmean(win, axis=0)
         if use_shrinkage:
             cov = ledoit_wolf_shrinkage(win)

@@ -1,0 +1,139 @@
+"""Mesh sharding: the sharded research step and combo sweep must reproduce
+their single-device results bit-for-bit (up to float reassociation) on the
+8-virtual-device CPU mesh (SURVEY.md section 4, multi-device testing)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu.backtest import SimulationSettings, run_simulation
+from factormodeling_tpu.multimanager import run_multimanager_backtest
+from factormodeling_tpu.parallel import (
+    balanced_mesh_shape,
+    build_research_step,
+    combo_weight_matrix,
+    make_mesh,
+    make_sharded_manager_sweep,
+    make_sharded_research_step,
+    manager_sweep,
+)
+
+F, D, N = 8, 32, 10
+NAMES = ("alpha_eq", "alpha_flx", "beta_long", "beta_short", "gamma_eq",
+         "gamma_flx", "delta_long", "delta_short")
+WINDOW = 6
+
+
+def make_inputs(rng):
+    factors = rng.normal(size=(F, D, N))
+    factors[rng.uniform(size=factors.shape) < 0.05] = np.nan
+    returns = rng.normal(scale=0.02, size=(D, N))
+    factor_ret = rng.normal(scale=0.01, size=(D, F))
+    cap = rng.integers(1, 4, size=(D, N)).astype(float)
+    invest = np.ones((D, N))
+    universe = np.ones((D, N), dtype=bool)
+    return tuple(jnp.asarray(x) for x in
+                 (factors, returns, factor_ret, cap, invest, universe))
+
+
+def test_balanced_mesh_shape():
+    assert balanced_mesh_shape(8) == (4, 2)
+    assert balanced_mesh_shape(6) == (3, 2)
+    assert balanced_mesh_shape(7) == (7, 1)
+    assert balanced_mesh_shape(1) == (1, 1)
+    assert balanced_mesh_shape(12, 3) == (3, 2, 2)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(("factor", "date"))
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("factor", "date")
+    flat = make_mesh(("combo",), n_devices=4)
+    assert flat.devices.shape == (4,)
+
+
+@pytest.mark.parametrize("select_method,sim_method", [
+    ("icir_top", "equal"),
+    ("momentum", "linear"),
+])
+def test_sharded_research_step_matches_single(rng, select_method, sim_method):
+    inputs = make_inputs(rng)
+    cfg = dict(names=NAMES, window=WINDOW, select_method=select_method,
+               sim_kwargs=dict(method=sim_method, pct=0.3, max_weight=0.4))
+    single = jax.jit(build_research_step(**cfg))(*inputs)
+
+    mesh = make_mesh(("factor", "date"))
+    step, shard_inputs = make_sharded_research_step(mesh, **cfg)
+    sharded = step(*shard_inputs(*inputs))
+
+    np.testing.assert_allclose(np.asarray(single.selection),
+                               np.asarray(sharded.selection), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(single.signal),
+                               np.asarray(sharded.signal), atol=1e-10,
+                               equal_nan=True)
+    np.testing.assert_allclose(np.asarray(single.sim.result.log_return),
+                               np.asarray(sharded.sim.result.log_return),
+                               atol=1e-10, equal_nan=True)
+    np.testing.assert_allclose(float(single.summary.sharpe),
+                               float(sharded.summary.sharpe), atol=1e-8)
+
+
+def test_research_step_mvo_shards(rng):
+    """The QP path (chunked lax.map of ADMM solves) must also compile and run
+    under the mesh shardings."""
+    inputs = make_inputs(rng)
+    cfg = dict(names=NAMES, window=WINDOW, select_method="icir_top",
+               sim_kwargs=dict(method="mvo", qp_iters=40, mvo_batch=8,
+                               lookback_period=8))
+    single = jax.jit(build_research_step(**cfg))(*inputs)
+    mesh = make_mesh(("factor", "date"))
+    step, shard_inputs = make_sharded_research_step(mesh, **cfg)
+    sharded = step(*shard_inputs(*inputs))
+    np.testing.assert_allclose(np.asarray(single.sim.result.log_return),
+                               np.asarray(sharded.sim.result.log_return),
+                               atol=1e-8, equal_nan=True)
+
+
+def make_sweep_inputs(rng, n_combos=8, k=2):
+    factors = rng.normal(size=(F, D, N))
+    returns = rng.normal(scale=0.02, size=(D, N))
+    cap = rng.integers(1, 4, size=(D, N)).astype(float)
+    invest = np.ones((D, N))
+    settings = SimulationSettings(returns=jnp.asarray(returns),
+                                  cap_flag=jnp.asarray(cap),
+                                  investability_flag=jnp.asarray(invest),
+                                  method="equal", pct=0.3)
+    combos = rng.integers(0, F, size=(n_combos, k))
+    cw = combo_weight_matrix(combos, F)
+    return jnp.asarray(factors), cw, combos, settings
+
+
+def test_combo_weight_matrix():
+    cw = np.asarray(combo_weight_matrix([[0, 2], [1, 1]], 4))
+    np.testing.assert_allclose(cw, [[0.5, 0, 0.5, 0], [0, 1.0, 0, 0]])
+
+
+def test_manager_sweep_matches_multimanager(rng):
+    factors, cw, combos, settings = make_sweep_inputs(rng, n_combos=4)
+    out = manager_sweep(factors, cw, settings, combo_batch=2)
+    for c in range(cw.shape[0]):
+        fw = jnp.broadcast_to(cw[c], (D, F))
+        mm = run_multimanager_backtest(factors, fw, settings)
+        np.testing.assert_allclose(np.asarray(out.log_return[c]),
+                                   np.asarray(mm.result.log_return),
+                                   atol=1e-9, equal_nan=True)
+
+
+def test_sharded_sweep_matches_single(rng):
+    factors, cw, _, settings = make_sweep_inputs(rng, n_combos=16)
+    single = manager_sweep(factors, cw, settings, combo_batch=4)
+    mesh = make_mesh(("combo",))
+    sweep = make_sharded_manager_sweep(mesh, combo_batch=2)
+    sharded = sweep(factors, cw, settings)
+    np.testing.assert_allclose(np.asarray(single.log_return),
+                               np.asarray(sharded.log_return), atol=1e-10,
+                               equal_nan=True)
+    np.testing.assert_allclose(np.asarray(single.sharpe),
+                               np.asarray(sharded.sharpe), atol=1e-8,
+                               equal_nan=True)

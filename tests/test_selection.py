@@ -95,3 +95,22 @@ def test_unknown_method_raises(rng):
     with pytest.raises(ValueError, match="Unknown factor selection method"):
         rolling_selection(jnp.array(factors), jnp.array(returns),
                           jnp.array(factor_ret), W, "nope")
+
+
+def test_mvo_selector_no_lookahead_for_early_dates(rng):
+    """Direct registry calls must not leak same-day/future factor returns
+    into the clamped early-date windows (today < window)."""
+    from factormodeling_tpu.selection.selectors import (
+        FACTOR_SELECTION_METHODS, SelectionContext)
+
+    factor_ret = rng.normal(scale=0.01, size=(D, F))
+    poisoned = factor_ret.copy()
+    poisoned[W // 2:] *= 100.0  # change today+future rows only
+
+    def run(fr):
+        ctx = SelectionContext(metrics_win={}, factor_ret=jnp.array(fr),
+                               ret_win_sum=jnp.zeros((D, F)), window=W)
+        return np.asarray(FACTOR_SELECTION_METHODS["mvo"](ctx, qp_iters=100))
+
+    a, b = run(factor_ret), run(poisoned)
+    np.testing.assert_allclose(a[: W // 2], b[: W // 2], atol=1e-12)
