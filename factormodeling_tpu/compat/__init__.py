@@ -1,0 +1,25 @@
+"""Pandas-facing compatibility layer: the reference library's exact API,
+backed by the dense TPU kernels.
+
+A user of the reference imports the same module names with the same call
+signatures and (date, symbol)-MultiIndex pandas objects:
+
+    from factormodeling_tpu.compat import operations as op
+    from factormodeling_tpu.compat.factor_selector import (
+        single_factor_metrics, FactorSelector)
+    from factormodeling_tpu.compat.composite_factor import (
+        composite_factor_calculation, weighted_composite_factor)
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation, SimulationSettings)
+    from factormodeling_tpu.compat.portfolio_analyzer import PortfolioAnalyzer
+    from factormodeling_tpu.compat import multi_manager
+
+Each call densifies its pandas inputs (``_convert``), dispatches to the
+jitted kernels, and realigns results to the caller's index. This is the
+"'jax' backend behind the existing plugin boundary" of BASELINE.json's north
+star: the pandas surface is unchanged, the compute runs on device.
+
+Precision note: conversions use the active JAX default float width — enable
+``jax.config.update("jax_enable_x64", True)`` for bit-level pandas parity;
+the float32 default is the TPU-native fast path.
+"""

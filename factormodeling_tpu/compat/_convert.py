@@ -1,0 +1,101 @@
+"""Long-format pandas <-> dense panel conversion for the compat layer.
+
+The reference's implicit L1 data model is a (date, symbol)-MultiIndex Series
+(SURVEY.md section 1); the dense analog is ``values[D, N]`` + ``universe``
+mask (:mod:`factormodeling_tpu.panel`). A :class:`PanelVocab` pins one shared
+(dates, symbols) vocabulary so every panel in a workflow densifies onto the
+same grid and results realign to the caller's own index.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+
+__all__ = ["PanelVocab", "level_values"]
+
+
+def level_values(index: pd.MultiIndex, name: str, position: int) -> pd.Index:
+    """A named MultiIndex level, falling back to position for unnamed levels."""
+    if name in (index.names or []):
+        return index.get_level_values(name)
+    return index.get_level_values(position)
+
+
+class PanelVocab:
+    """Shared sorted (dates, symbols) vocabulary for a set of long indexes."""
+
+    def __init__(self, dates: pd.Index, symbols: pd.Index):
+        self.dates = pd.Index(dates)
+        self.symbols = pd.Index(symbols)
+
+    @classmethod
+    def from_indexes(cls, *indexes: pd.MultiIndex) -> "PanelVocab":
+        dates: pd.Index | None = None
+        symbols: pd.Index | None = None
+        for idx in indexes:
+            d = pd.Index(level_values(idx, "date", 0).unique())
+            s = pd.Index(level_values(idx, "symbol", 1).unique())
+            dates = d if dates is None else dates.union(d)
+            symbols = s if symbols is None else symbols.union(s)
+        return cls(dates.sort_values(), symbols.sort_values())
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return len(self.dates), len(self.symbols)
+
+    def codes(self, index: pd.MultiIndex) -> tuple[np.ndarray, np.ndarray]:
+        di = self.dates.get_indexer(level_values(index, "date", 0))
+        si = self.symbols.get_indexer(level_values(index, "symbol", 1))
+        return di, si
+
+    def densify(self, s: pd.Series) -> tuple[np.ndarray, np.ndarray]:
+        """(values[D, N] float with NaN holes, universe[D, N] bool)."""
+        d, n = self.shape
+        values = np.full((d, n), np.nan)
+        universe = np.zeros((d, n), dtype=bool)
+        di, si = self.codes(s.index)
+        keep = (di >= 0) & (si >= 0)
+        values[di[keep], si[keep]] = pd.to_numeric(s, errors="coerce").to_numpy(
+            dtype=float, na_value=np.nan)[keep]
+        universe[di[keep], si[keep]] = True
+        return values, universe
+
+    def densify_labels(self, s: pd.Series) -> tuple[np.ndarray, int]:
+        """Categorical labels -> int ids [D, N] (missing/NaN -> -1), count."""
+        d, n = self.shape
+        codes, _uniques = pd.factorize(np.asarray(s), use_na_sentinel=True)
+        out = np.full((d, n), -1, dtype=np.int32)
+        di, si = self.codes(s.index)
+        keep = (di >= 0) & (si >= 0)
+        out[di[keep], si[keep]] = codes[keep]
+        return out, len(_uniques)
+
+    def to_series(self, arr, universe: np.ndarray, name=None) -> pd.Series:
+        """Dense array -> long Series over the universe cells, sorted index."""
+        arr = np.asarray(arr)
+        di, si = np.nonzero(universe)
+        idx = pd.MultiIndex.from_arrays(
+            [self.dates.take(di), self.symbols.take(si)],
+            names=["date", "symbol"])
+        return pd.Series(arr[di, si], index=idx, name=name)
+
+    def align_like(self, arr, index: pd.MultiIndex, name=None) -> pd.Series:
+        """Dense array -> Series on the caller's own index (row order kept)."""
+        arr = np.asarray(arr)
+        di, si = self.codes(index)
+        out = np.full(len(index), np.nan, dtype=arr.dtype)
+        keep = (di >= 0) & (si >= 0)
+        out[keep] = arr[di[keep], si[keep]]
+        return pd.Series(out, index=index, name=name)
+
+
+def roundtrip(series: pd.Series, fn, name=None) -> pd.Series:
+    """Densify -> kernel -> realign, the universal unary-op wrapper.
+    ``fn(values, universe)`` gets jnp arrays and returns a dense [D, N]."""
+    vocab = PanelVocab.from_indexes(series.index)
+    values, universe = vocab.densify(series)
+    out = fn(jnp.asarray(values), jnp.asarray(universe))
+    return vocab.align_like(out, series.index, name=name if name is not None
+                            else series.name)

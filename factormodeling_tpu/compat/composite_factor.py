@@ -1,0 +1,92 @@
+"""Reference ``composite_factor.py`` surface: static & weighted blends plus
+the two plotting helpers, over pandas panels.
+
+The blend math runs on device through :mod:`factormodeling_tpu.composite`
+(suffix preprocessing, prefix-group proxies, zscore/rank normalize, demean —
+``composite_factor.py:137-342``); this module only converts formats and keeps
+the reference's output conventions (static: NaN-preserving Series on the
+panel index; weighted: zero-filled on the full panel index).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+
+from factormodeling_tpu.analytics.quantile import quantile_backtest_log
+from factormodeling_tpu.analytics.plots import (
+    plot_factor_distributions as _plot_dists,
+    plot_quantile_backtests as _plot_quantiles,
+)
+from factormodeling_tpu.compat._convert import PanelVocab
+from factormodeling_tpu.composite import composite_static, composite_weighted
+
+__all__ = ["composite_factor_calculation", "weighted_composite_factor",
+           "plot_factor_distributions", "plot_quantile_backtests_log"]
+
+
+def _stack(factors_df: pd.DataFrame, columns, vocab: PanelVocab):
+    stack = np.empty((len(columns),) + vocab.shape)
+    universe = np.zeros(vocab.shape, dtype=bool)
+    for i, col in enumerate(columns):
+        vals, uni = vocab.densify(factors_df[col])
+        stack[i] = vals
+        universe |= uni
+    return stack, universe
+
+
+def composite_factor_calculation(factors_df: pd.DataFrame,
+                                 selected_factors: list,
+                                 method: str = "zscore") -> pd.Series:
+    """Static equal blend of the selected factor columns
+    (``composite_factor.py:137-218``). Returns the per-date demeaned
+    composite on the panel's long index (NaN preserved)."""
+    vocab = PanelVocab.from_indexes(factors_df.index)
+    stack, universe = _stack(factors_df, selected_factors, vocab)
+    comp = composite_static(jnp.asarray(stack), tuple(selected_factors),
+                            method=method, universe=jnp.asarray(universe))
+    return vocab.align_like(comp, factors_df.index, name="composite")
+
+
+def weighted_composite_factor(factors_df: pd.DataFrame,
+                              selection_df: pd.DataFrame,
+                              method: str = "zscore") -> pd.Series:
+    """Per-date weighted blend driven by daily selection weights
+    (``composite_factor.py:220-342``). Zero-filled on the full panel index
+    like the reference's final ``reindex().fillna(0)``."""
+    names = list(selection_df.columns)
+    vocab = PanelVocab.from_indexes(factors_df.index)
+    stack, universe = _stack(factors_df, names, vocab)
+    sel = selection_df.reindex(vocab.dates).fillna(0.0).to_numpy()
+    comp = composite_weighted(jnp.asarray(stack), tuple(names),
+                              jnp.asarray(sel), method=method,
+                              universe=jnp.asarray(universe))
+    return vocab.align_like(comp, factors_df.index, name="composite")
+
+
+def plot_factor_distributions(factors_df: pd.DataFrame, exclude=None,
+                              bins=50, ncols=6, figsize=(15, 5)):
+    """Histogram grid of factor distributions (``composite_factor.py:17-44``)."""
+    vocab = PanelVocab.from_indexes(factors_df.index)
+    names = list(factors_df.columns)
+    stack, _ = _stack(factors_df, names, vocab)
+    return _plot_dists(stack, names, exclude=exclude, bins=bins, ncols=ncols,
+                       figsize=figsize)
+
+
+def plot_quantile_backtests_log(com_factors_df: pd.DataFrame,
+                                returns: pd.Series, n_groups: int = 5,
+                                ncols: int = 2, figsize=(20, 6)):
+    """Per-factor n-quantile bucket backtest in log-return space with the
+    L1-Sn spread (``composite_factor.py:47-134``)."""
+    vocab = PanelVocab.from_indexes(com_factors_df.index, returns.index)
+    rets, _ = vocab.densify(returns)
+    results = {}
+    for col in com_factors_df.columns:
+        vals, uni = vocab.densify(com_factors_df[col])
+        results[col] = quantile_backtest_log(
+            jnp.asarray(vals), jnp.asarray(rets), n_groups=n_groups,
+            universe=jnp.asarray(uni))
+    return _plot_quantiles(results, vocab.dates.to_numpy(), n_groups=n_groups,
+                           ncols=ncols, figsize=figsize)

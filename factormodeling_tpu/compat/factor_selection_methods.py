@@ -1,0 +1,95 @@
+"""Reference ``factor_selection_methods.py`` surface: selector plugins with
+the exact reference signature
+
+    (metrics_df, factors_win, returns_win, factor_ret_win, today, window,
+     **kwargs) -> pd.Series of non-negative factor weights named by date.
+
+These are the single-date host-level plugins (the plugin boundary of
+``factor_selector.py:20-24``); :class:`~...factor_selector.FactorSelector`
+routes the built-in method names through the O(D*F) dense rolling path and
+only calls these per date for user-registered custom methods. The QP inside
+``mvo_selector`` runs on device through the batched ADMM solver — the compat
+layer's replacement for cvxpy/OSQP.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+
+from factormodeling_tpu.selection import ledoit_wolf_shrinkage as _lw_dense
+from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_dense
+
+__all__ = ["icir_top_selector", "factor_momentum_selector",
+           "ledoit_wolf_shrinkage", "mvo_selector"]
+
+
+def icir_top_selector(metrics_df, factors_win, returns_win, factor_ret_win,
+                      today, window, icir_threshold=0.03, top_x=5,
+                      use_rank_icir=True, **kwargs):
+    """Equal-weight the top-x factors above the ICIR threshold
+    (reference ``factor_selection_methods.py:6-26``)."""
+    col = "rank_IC_IR" if use_rank_icir else "IC_IR"
+    score = metrics_df[col]
+    picked = score[score > icir_threshold].nlargest(top_x)
+    weights = pd.Series(0.0, index=metrics_df.index, name=today)
+    if len(picked):
+        weights[picked.index] = 1.0 / len(picked)
+    return weights
+
+
+def factor_momentum_selector(metrics_df, factors_win, returns_win,
+                             factor_ret_win, today, window, max_weight=1.0,
+                             **kwargs):
+    """Weights proportional to the window-sum of factor returns, floored at 0
+    and capped at ``max_weight`` only when it is < 1
+    (reference ``factor_selection_methods.py:28-58``)."""
+    mom = factor_ret_win.sum(axis=0).clip(lower=0.0)
+    if max_weight < 1.0:
+        mom = mom.clip(upper=max_weight)
+    total = mom.sum()
+    weights = mom / total if total > 0 else mom * 0.0
+    weights.name = today
+    return weights
+
+
+def ledoit_wolf_shrinkage(returns):
+    """Constant-correlation Ledoit-Wolf shrunk covariance
+    (reference ``factor_selection_methods.py:60-117``), computed on device in
+    closed form instead of the reference's O(n*p^2) Python loop."""
+    arr = np.asarray(returns, dtype=float)
+    out = np.asarray(_lw_dense(jnp.asarray(arr)))
+    if isinstance(returns, pd.DataFrame):
+        return pd.DataFrame(out, index=returns.columns, columns=returns.columns)
+    return out
+
+
+def mvo_selector(metrics_df, factors_win, returns_win, factor_ret_win, today,
+                 window, risk_aversion=1.0, max_weight=1.0,
+                 turnover_penalty=0.0, previous_weights=None,
+                 use_shrinkage=True, qp_iters=500, **kwargs):
+    """Max-Sharpe factor weights on the capped simplex via the device ADMM QP
+    (reference ``factor_selection_methods.py:119-175``; solver failure ->
+    zero weights, the reference's fallback)."""
+    cols = factor_ret_win.columns
+    f = len(cols)
+    mu = factor_ret_win.mean(axis=0).to_numpy()
+    if use_shrinkage:
+        cov = np.asarray(ledoit_wolf_shrinkage(factor_ret_win))
+    else:
+        cov = factor_ret_win.cov().to_numpy()
+    cov = 0.5 * (cov + cov.T)
+    prev = (previous_weights.reindex(cols).fillna(0.0).to_numpy()
+            if previous_weights is not None else np.zeros(f))
+    cap = min(max_weight, 1.0)
+    prob = BoxQPProblem(
+        q=jnp.asarray(-mu), lo=jnp.zeros(f), hi=jnp.full(f, cap),
+        E=jnp.ones((1, f)), b=jnp.ones(1),
+        l1=jnp.asarray(float(turnover_penalty)), center=jnp.asarray(prev))
+    res = admm_solve_dense(jnp.asarray(2.0 * risk_aversion * cov), prob,
+                           iters=qp_iters)
+    w = np.asarray(res.x, dtype=float)
+    if not np.all(np.isfinite(w)):
+        w = np.zeros(f)
+    return pd.Series(np.maximum(w, 0.0), index=cols, name=today)

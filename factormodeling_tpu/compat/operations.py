@@ -1,0 +1,234 @@
+"""Reference ``operations.py`` surface over pandas panels, computed on device.
+
+Every function keeps the reference's name, signature, and semantics
+(``/root/reference/operations.py``, line cites per op) but routes through the
+dense masked kernels in :mod:`factormodeling_tpu.ops`. Inputs are
+(date, symbol)-MultiIndex Series; outputs realign to the input's own index.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+
+from factormodeling_tpu import ops as k
+from factormodeling_tpu.compat._convert import PanelVocab, roundtrip
+
+__all__ = [
+    "ts_sum", "ts_mean", "ts_std", "ts_zscore", "ts_rank", "ts_diff",
+    "ts_delay", "ts_decay", "ts_backfill",
+    "cs_rank", "cs_winsor", "cs_filter_center", "cs_zscore", "cs_bool",
+    "cs_mean",
+    "sign", "power", "log", "abs_", "clip",
+    "bucket", "group_mean", "group_neutralize", "group_normalize",
+    "group_rank_normalized", "market_neutralize",
+    "ts_regression_fast", "cs_regression",
+]
+
+
+# ---------------------------------------------------------------- time-series
+
+def ts_sum(series: pd.Series, window: int) -> pd.Series:
+    """Rolling per-symbol sum (``operations.py:6``)."""
+    return roundtrip(series, lambda v, u: k.ts_sum(v, window, universe=u))
+
+
+def ts_mean(series: pd.Series, window: int) -> pd.Series:
+    """Rolling per-symbol mean (``operations.py:10``)."""
+    return roundtrip(series, lambda v, u: k.ts_mean(v, window, universe=u))
+
+
+def ts_std(series: pd.Series, window: int) -> pd.Series:
+    """Rolling per-symbol std, ddof=1 (``operations.py:14``)."""
+    return roundtrip(series, lambda v, u: k.ts_std(v, window, universe=u))
+
+
+def ts_zscore(series: pd.Series, window: int) -> pd.Series:
+    """(x - rolling mean) / rolling std, zero-std -> NaN (``operations.py:18``)."""
+    return roundtrip(series, lambda v, u: k.ts_zscore(v, window, universe=u))
+
+
+def ts_rank(series: pd.Series, window: int) -> pd.Series:
+    """Trailing-window pct rank of the last value (``operations.py:23``)."""
+    return roundtrip(series, lambda v, u: k.ts_rank(v, window, universe=u))
+
+
+def ts_diff(series: pd.Series, window: int) -> pd.Series:
+    """x - x.shift(window) per symbol (``operations.py:34``)."""
+    return roundtrip(series, lambda v, u: k.ts_diff(v, window, universe=u))
+
+
+def ts_delay(series: pd.Series, window: int) -> pd.Series:
+    """x.shift(window) per symbol (``operations.py:37``)."""
+    return roundtrip(series, lambda v, u: k.ts_delay(v, window, universe=u))
+
+
+def ts_decay(series: pd.Series, window: int) -> pd.Series:
+    """Linear-decay weighted mean, weights 1..w (``operations.py:40``)."""
+    return roundtrip(series, lambda v, u: k.ts_decay(v, window, universe=u))
+
+
+def ts_backfill(series: pd.Series) -> pd.Series:
+    """Per-symbol forward fill (``operations.py:50``; the reference name is
+    misleading — it is ffill, preserved as such)."""
+    return roundtrip(series, lambda v, u: k.ts_backfill(v, universe=u))
+
+
+# ------------------------------------------------------------- cross-section
+
+def cs_rank(series: pd.Series, method: str = "average") -> pd.Series:
+    """Per-date [0, 1] rank, (r-1)/(n-1) with the reference's NaN-counting
+    denominator (``operations.py:54``). Only average tie-handling (the
+    reference default) is implemented."""
+    if method != "average":
+        raise NotImplementedError("cs_rank: only method='average' is supported")
+    return roundtrip(series, lambda v, u: k.cs_rank(v, universe=u))
+
+
+def cs_winsor(series: pd.Series, limits=(0.01, 0.99)) -> pd.Series:
+    """Clip to the per-date quantile band; skipped below 5 valid names
+    (``operations.py:64``)."""
+    return roundtrip(series, lambda v, u: k.cs_winsor(v, limits, universe=u))
+
+
+def cs_filter_center(series: pd.Series, center=(0.3, 0.7)) -> pd.Series:
+    """Zero out the middle quantile band, keep the tails (``operations.py:70``)."""
+    return roundtrip(series, lambda v, u: k.cs_filter_center(v, center, universe=u))
+
+
+def cs_zscore(series: pd.Series) -> pd.Series:
+    """Per-date zscore, ddof=0 (``operations.py:77``)."""
+    return roundtrip(series, lambda v, u: k.cs_zscore(v, universe=u))
+
+
+def cs_bool(condition: pd.Series, true_value: float, false_value: float) -> pd.Series:
+    """np.where passthrough (``operations.py:80``)."""
+    return pd.Series(np.where(np.asarray(condition, dtype=bool), true_value,
+                              false_value),
+                     index=condition.index, name=condition.name)
+
+
+def cs_mean(series: pd.Series) -> pd.Series:
+    """Per-date mean broadcast back to every name (``operations.py:85``)."""
+    return roundtrip(series, lambda v, u: k.cs_mean(v, universe=u))
+
+
+def market_neutralize(series: pd.Series) -> pd.Series:
+    """Per-date zscore ddof=0 with zero-sigma -> 0 (``operations.py:171``;
+    despite the name it is a zscore, not a demean — preserved)."""
+    return roundtrip(series, lambda v, u: k.market_neutralize(v, universe=u))
+
+
+# ---------------------------------------------------------------- elementwise
+
+def sign(series: pd.Series) -> pd.Series:
+    """np.sign (``operations.py:88``)."""
+    return pd.Series(np.sign(series.to_numpy(dtype=float, na_value=np.nan)),
+                     index=series.index, name=series.name)
+
+
+def power(series: pd.Series, exp: float) -> pd.Series:
+    """Elementwise power (``operations.py:91``)."""
+    return pd.Series(np.power(series.to_numpy(dtype=float, na_value=np.nan), exp),
+                     index=series.index, name=series.name)
+
+
+def log(series: pd.Series) -> pd.Series:
+    """Elementwise natural log (``operations.py:94``)."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = np.log(series.to_numpy(dtype=float, na_value=np.nan))
+    return pd.Series(out, index=series.index, name=series.name)
+
+
+def abs_(series: pd.Series) -> pd.Series:
+    """Elementwise absolute value (``operations.py:97``)."""
+    return pd.Series(np.abs(series.to_numpy(dtype=float, na_value=np.nan)),
+                     index=series.index, name=series.name)
+
+
+def clip(series: pd.Series, lower, upper) -> pd.Series:
+    """Elementwise clip (``operations.py:100``)."""
+    return pd.Series(np.clip(series.to_numpy(dtype=float, na_value=np.nan),
+                             lower, upper),
+                     index=series.index, name=series.name)
+
+
+# --------------------------------------------------------------------- groups
+
+def bucket(series: pd.Series, bin_range=(0.2, 1.0, 0.2)) -> pd.Series:
+    """Fixed-bin labels "group{i}" per date (``operations.py:104``); values
+    outside the bins (and NaN) -> NaN, like pd.cut."""
+    vocab = PanelVocab.from_indexes(series.index)
+    values, universe = vocab.densify(series)
+    ids = np.asarray(k.bucket(jnp.asarray(values), bin_range))
+    aligned = vocab.align_like(ids.astype(float), series.index)
+    labels = aligned.map(lambda v: f"group{int(v) + 1}"
+                         if np.isfinite(v) and v >= 0 else np.nan)
+    labels.name = series.name
+    return labels
+
+
+def _group_op(series: pd.Series, group: pd.Series, kernel) -> pd.Series:
+    """Shared densify path for per-(date, group) ops: NaN-labelled cells are
+    dropped by pandas groupby -> NaN output, mirrored via a sentinel id."""
+    vocab = PanelVocab.from_indexes(series.index, group.index)
+    values, universe = vocab.densify(series)
+    gids, n_groups = vocab.densify_labels(group)
+    missing = gids < 0
+    gids = np.where(missing, n_groups, gids)  # sentinel bucket, masked below
+    out = kernel(jnp.asarray(values), jnp.asarray(gids), n_groups + 1)
+    out = np.array(out)  # copy: jax buffers are read-only
+    out[missing] = np.nan
+    return vocab.align_like(out, series.index, name=series.name)
+
+
+def group_mean(series: pd.Series, group: pd.Series) -> pd.Series:
+    """Per-(date, group) NaN-skipping mean (``operations.py:112``)."""
+    return _group_op(series, group, k.group_mean)
+
+
+def group_neutralize(series: pd.Series, group: pd.Series) -> pd.Series:
+    """x minus its per-(date, group) mean (``operations.py:124``)."""
+    return _group_op(series, group, k.group_neutralize)
+
+
+def group_normalize(series: pd.Series, group: pd.Series) -> pd.Series:
+    """Per-(date, group) zscore ddof=0, zero-sigma -> 0 (``operations.py:137``)."""
+    return _group_op(series, group, k.group_normalize)
+
+
+def group_rank_normalized(series: pd.Series, group: pd.Series,
+                          method: str = "average") -> pd.Series:
+    """Per-(date, group) [0, 1] rank, <=1 valid -> 0.5 (``operations.py:152``)."""
+    if method != "average":
+        raise NotImplementedError(
+            "group_rank_normalized: only method='average' is supported")
+    return _group_op(series, group, k.group_rank_normalized)
+
+
+# ----------------------------------------------------------------- regression
+
+def ts_regression_fast(y: pd.Series, x: pd.Series, window: int, lag: int = 0,
+                       rettype: int = 2) -> pd.Series:
+    """Per-symbol rolling OLS y ~ x (``operations.py:185``); rettype 0=resid,
+    1=alpha, 2=beta, 3=fitted, 6=R^2. NB the dense kernel lags x per symbol
+    (the reference's positional long-frame shift can leak across symbols — a
+    documented deliberate fix)."""
+    vocab = PanelVocab.from_indexes(y.index, x.index)
+    yv, yu = vocab.densify(y)
+    xv, xu = vocab.densify(x)
+    out = k.ts_regression_fast(jnp.asarray(yv), jnp.asarray(xv), window,
+                               lag=lag, rettype=rettype,
+                               universe=jnp.asarray(yu | xu))
+    return vocab.align_like(out, y.index, name=y.name)
+
+
+def cs_regression(y: pd.Series, x: pd.Series, rettype: str = "resid") -> pd.Series:
+    """Per-date OLS y ~ x (``operations.py:248``); rettype in
+    {resid, beta, alpha, fitted, r2}; < 2 valid pairs -> NaN date."""
+    vocab = PanelVocab.from_indexes(y.index, x.index)
+    yv, _ = vocab.densify(y)
+    xv, _ = vocab.densify(x)
+    out = k.cs_regression(jnp.asarray(yv), jnp.asarray(xv), rettype=rettype)
+    return vocab.align_like(out, y.index, name=y.name)

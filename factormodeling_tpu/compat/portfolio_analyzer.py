@@ -1,0 +1,41 @@
+"""Reference ``portfolio_analyzer.py`` surface: ``PortfolioAnalyzer`` over a
+result DataFrame (the frame ``Simulation._daily_portfolio_returns`` emits,
+with a ``date`` column and ``log_return``/leg/turnover columns).
+
+Thin adapter over :class:`factormodeling_tpu.analytics.PortfolioAnalyzer`:
+metric names, the log->simple conversion (``portfolio_analyzer.py:18``), the
+calendar-day annualization, and ``summary()``'s formatted strings all live
+there; this class adds the reference's DataFrame-facing constructor and the
+dashboard method name."""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from factormodeling_tpu.analytics import PortfolioAnalyzer as _DenseAnalyzer
+from factormodeling_tpu.analytics.plots import plot_full_performance
+
+__all__ = ["PortfolioAnalyzer"]
+
+_COLUMNS = ("log_return", "long_return", "short_return",
+            "long_turnover", "short_turnover", "turnover")
+
+
+class PortfolioAnalyzer(_DenseAnalyzer):
+    def __init__(self, df: pd.DataFrame, trading_days_per_year: int = 252):
+        dates = pd.to_datetime(df["date"] if "date" in df.columns
+                               else df.index)
+        cols = {c: df[c].to_numpy() for c in _COLUMNS if c in df.columns}
+        if "log_return" not in cols:
+            raise ValueError("result frame needs a log_return column")
+        super().__init__(cols, dates.to_numpy(),
+                         trading_days_per_year=trading_days_per_year)
+
+    def plot_full_performance(self, counts_df: pd.DataFrame | None = None):
+        """The 6-panel dashboard (``portfolio_analyzer.py:83-260``)."""
+        counts = None
+        if counts_df is not None:
+            counts = (counts_df.index.to_numpy(),
+                      counts_df["long_count"].to_numpy(),
+                      counts_df["short_count"].to_numpy())
+        return plot_full_performance(self, counts)

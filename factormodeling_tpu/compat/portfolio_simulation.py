@@ -1,0 +1,197 @@
+"""Reference ``portfolio_simulation.py`` surface: ``SimulationSettings`` +
+``Simulation`` over pandas panels, executing on device.
+
+The class keeps the reference's constructor, ``run()`` side effects
+(registering the signal into the shared ``factors_df``, ``:72``; summary /
+contributor prints; dashboard plot) and the "private" methods multi_manager
+reaches into (``_daily_trade_list``, ``_daily_portfolio_returns``). The daily
+loop itself is the dense engine: one jitted pass for weights, shift, and
+P&L. ``use_cvxpy`` / ``mvo_solver`` are accepted for signature parity and
+ignored — there is one device solver (the batched ADMM QP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+
+from factormodeling_tpu.analytics import PortfolioAnalyzer as _DenseAnalyzer
+from factormodeling_tpu.analytics.plots import plot_full_performance
+from factormodeling_tpu.backtest import (
+    SimulationSettings as _DenseSettings,
+    daily_trade_list as _dense_trade_list,
+)
+from factormodeling_tpu.backtest.pnl import daily_portfolio_returns as _dense_pnl
+from factormodeling_tpu.backtest.pnl import signal_metrics as _dense_signal_metrics
+from factormodeling_tpu.compat._convert import PanelVocab, level_values
+
+__all__ = ["SimulationSettings", "Simulation"]
+
+_RESULT_COLUMNS = ("log_return", "long_return", "short_return",
+                   "long_turnover", "short_turnover", "turnover")
+
+
+@dataclasses.dataclass
+class SimulationSettings:
+    """Reference settings dataclass (``portfolio_simulation.py:10-33``),
+    pandas panels + identical knobs/defaults."""
+
+    returns: pd.Series
+    cap_flag: pd.Series
+    investability_flag: pd.Series
+    factors_df: pd.DataFrame
+    method: str = "equal"
+    transaction_cost: bool = True
+    max_weight: float = 0.03
+    pct: float = 0.1
+    min_universe: int = 1000    # parity only; the reference never uses it
+    contributor: bool = False
+    output_summary: bool = False
+    output_returns: bool = False
+    plot: bool = True
+    lookback_period: int = 60
+    use_cvxpy: bool = True      # parity only; one device solver
+    mvo_solver: str = "OSQP"    # parity only
+    shrinkage_intensity: float = 0.1
+    turnover_penalty: float = 0.1
+    return_weight: float = 0.0
+    # device-solver knobs (compat extras with safe defaults)
+    qp_iters: int = 500
+    mvo_batch: int = 32
+
+
+class Simulation:
+    """Daily long/short simulation of one signal
+    (reference ``Simulation``, ``portfolio_simulation.py:35-154``)."""
+
+    def __init__(self, name: str, custom_feature: pd.Series,
+                 settings: SimulationSettings):
+        self.name = name
+        self.custom_feature = custom_feature
+        self.settings = settings
+        for field in dataclasses.fields(settings):
+            setattr(self, field.name, getattr(settings, field.name))
+        self._vocab = PanelVocab.from_indexes(self.returns.index,
+                                              custom_feature.index)
+
+    # ------------------------------------------------------------ internals
+
+    def _dense_settings(self, signal_universe: np.ndarray,
+                        vocab: PanelVocab | None = None) -> _DenseSettings:
+        vocab = vocab if vocab is not None else self._vocab
+        rets, _ = vocab.densify(self.returns)
+        cap, _ = vocab.densify(self.cap_flag)
+        inv, _ = vocab.densify(self.investability_flag)
+        return _DenseSettings(
+            returns=jnp.asarray(rets), cap_flag=jnp.asarray(cap),
+            investability_flag=jnp.asarray(inv),
+            universe=jnp.asarray(signal_universe),
+            method=self.method, transaction_cost=self.transaction_cost,
+            max_weight=self.max_weight, pct=self.pct,
+            min_universe=self.min_universe, contributor=self.contributor,
+            lookback_period=self.lookback_period,
+            shrinkage_intensity=self.shrinkage_intensity,
+            turnover_penalty=self.turnover_penalty,
+            return_weight=self.return_weight,
+            qp_iters=self.qp_iters, mvo_batch=self.mvo_batch)
+
+    def _signal_dense(self):
+        sig, uni = self._vocab.densify(self.custom_feature)
+        return sig, uni
+
+    # ----------------------------------------------------------- public API
+
+    def run(self):
+        """Full backtest (``portfolio_simulation.py:71-94``): registers the
+        signal into the shared factors_df (reference side effect), simulates,
+        prints/plots per the toggles, returns the result frame when
+        ``output_returns`` is set."""
+        if self.factors_df is not None:
+            self.factors_df[self.name] = self.custom_feature
+        self.custom_feature = self.custom_feature * self.investability_flag
+        weights, counts = self._daily_trade_list()
+        result, top_longs, top_shorts = self._daily_portfolio_returns(weights)
+        analyzer = _DenseAnalyzer(
+            {c: result[c].to_numpy() for c in _RESULT_COLUMNS},
+            result["date"].to_numpy())
+
+        if self.output_summary:
+            metrics = self._calculate_metrics(weights, counts)
+            summary_df = (pd.DataFrame.from_dict(analyzer.summary(),
+                                                 orient="index",
+                                                 columns=["Value"])
+                          .reset_index().rename(columns={"index": "Metric"}))
+            print(metrics.to_string(index=False))
+            print(summary_df.to_string(index=False))
+        if self.contributor:
+            print("Top 10 long leg contributors:", top_longs)
+            print("Top 10 short leg contributors:", top_shorts)
+        if self.plot:
+            plot_full_performance(analyzer,
+                                  (counts.index.to_numpy(),
+                                   counts["long_count"].to_numpy(),
+                                   counts["short_count"].to_numpy()))
+        if self.output_returns:
+            return result
+        return None
+
+    def _daily_trade_list(self):
+        """(shifted weights Series, counts DataFrame)
+        (``portfolio_simulation.py:96-154``). Weights cover the signal's own
+        (date, symbol) cells, already lagged one day per symbol.
+
+        NB like the reference, the investability mask is NOT applied here —
+        only ``run()`` pre-masks (``:73``); direct callers (multi_manager)
+        trade the raw signal."""
+        sig, uni = self._vocab.densify(self.custom_feature)
+        s = self._dense_settings(uni)
+        w, lc, sc = _dense_trade_list(jnp.asarray(sig), s)
+        weights = self._vocab.to_series(np.asarray(w), uni, name="weight")
+        sig_dates = pd.Index(
+            level_values(self.custom_feature.index, "date", 0).unique())
+        date_mask = self._vocab.dates.isin(sig_dates)
+        counts = pd.DataFrame(
+            {"long_count": np.asarray(lc)[date_mask].astype(int),
+             "short_count": np.asarray(sc)[date_mask].astype(int)},
+            index=pd.Index(self._vocab.dates[date_mask], name="date"))
+        return weights, counts
+
+    def _daily_portfolio_returns(self, weights: pd.Series):
+        """Result frame sorted date-desc + top-10 contributors when enabled
+        (``portfolio_simulation.py:748-797``).
+
+        The turnover diff runs over the dates *present in the weights index*
+        — the reference unstacks the long weights, so a date whose rows were
+        all dropped (e.g. an all-zero multimanager day) is skipped by
+        ``.diff()`` rather than traded through."""
+        w_dates = pd.Index(
+            level_values(weights.index, "date", 0).unique()).sort_values()
+        vocab = PanelVocab(w_dates, self._vocab.symbols)
+        wv, _ = vocab.densify(weights)
+        s = self._dense_settings(np.ones(vocab.shape, dtype=bool), vocab)
+        res = _dense_pnl(jnp.asarray(wv), s)
+        result = pd.DataFrame({"date": vocab.dates,
+                               **{c: np.asarray(getattr(res, c))
+                                  for c in _RESULT_COLUMNS}})
+        result = (result.sort_values("date", ascending=False)
+                  .reset_index(drop=True))
+        if self.contributor:
+            longs = pd.Series(np.asarray(res.long_pnl_by_name),
+                              index=vocab.symbols)
+            shorts = pd.Series(np.asarray(res.short_pnl_by_name),
+                               index=vocab.symbols)
+            return result, longs.nlargest(10), shorts.nlargest(10)
+        return result, None, None
+
+    def _calculate_metrics(self, weights: pd.Series,
+                           counts: pd.DataFrame) -> pd.DataFrame:
+        """Daily-IC / turnover summary line (``portfolio_simulation.py:799``)."""
+        sig, uni = self._vocab.densify(self.custom_feature)
+        wv, _ = self._vocab.densify(weights)
+        s = self._dense_settings(uni)
+        m = _dense_signal_metrics(jnp.asarray(sig), jnp.asarray(wv), s)
+        return pd.DataFrame([{"name": self.name,
+                              **{k: float(v) for k, v in m.items()}}])

@@ -8,9 +8,9 @@ loop to combine books (``multi_manager.py:41-73``).
 TPU design: the per-factor weight pass is ``vmap``'d over the manager axis
 (one compiled kernel producing ``[M, D, N]`` books), and the combination is a
 single einsum contraction over managers. NaN semantics of the reference's
-``.add(..., fill_value=0)`` carry over: a manager with weight 0 that day is
-skipped entirely (its NaNs don't poison the sum), an active manager's NaN
-propagates and is later zero-filled by the P&L pivots.
+``.add(..., fill_value=0)`` carry over: pandas replaces NaN *values* (not
+just missing labels) with the fill before adding, so every NaN manager
+weight — and NaN factor weight — contributes exactly 0.
 """
 
 from __future__ import annotations
@@ -59,10 +59,10 @@ def compute_multimanager_weights(factors: jnp.ndarray,
     Returns (combined weights [D, N], long_count [D], short_count [D]).
     """
     books, lc, sc = compute_manager_weights(factors, settings)
-    fw = factor_weights.T[:, :, None]  # [M, D, 1]
-    # skip zero-weight managers entirely (their NaNs must not propagate)
-    term = jnp.where(fw == 0.0, 0.0, fw * books)
-    combined = term.sum(axis=0)
+    fw = jnp.nan_to_num(factor_weights)  # [D, M]
+    combined = jnp.einsum("md,mdn->dn", fw.T, jnp.nan_to_num(books))
+    # counts have no fill_value in the reference (multi_manager.py:69-70):
+    # a NaN factor weight makes that date's counts NaN
     lc_c = (factor_weights.T * lc).sum(axis=0)
     sc_c = (factor_weights.T * sc).sum(axis=0)
     return combined, lc_c, sc_c

@@ -64,15 +64,12 @@ def _combine_and_pnl(books: jnp.ndarray, combo_weights: jnp.ndarray,
                      settings: SimulationSettings, combo_batch: int) -> SweepOutput:
     """Contract replicated books ``[F, D, N]`` against local combo weights
     ``[Cl, F]``; chunked so the working set stays ``[combo_batch, D, N]``."""
+    # pandas .add(fill_value=0) zero-fills NaN values before adding
+    # (multi_manager docstring), so the combination is one clean contraction
     clean = jnp.nan_to_num(books)
-    # an *active* manager's NaN poisons the combined cell (then zero-filled in
-    # the P&L, multi_manager docstring); an inactive manager's NaN is skipped
-    nan_books = jnp.isnan(books).astype(books.dtype)
 
     def one_combo(w):  # w: [F]; lax.map vmaps this over combo_batch-sized chunks
         combined = jnp.einsum("f,fdn->dn", w, clean)
-        hit = jnp.einsum("f,fdn->dn", (w != 0.0).astype(books.dtype), nan_books)
-        combined = jnp.where(hit > 0, jnp.nan, combined)
         res = daily_portfolio_returns(combined, settings)
         summ = result_summary(res)
         return SweepOutput(

@@ -1,0 +1,321 @@
+"""Compat layer end-to-end surfaces vs the pandas oracle: factor_selector,
+composite_factor, portfolio_simulation, portfolio_analyzer, multi_manager.
+The oracle re-implements the reference's semantics; these tests exercise the
+pandas plumbing on top of the (separately oracle-tested) dense kernels."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests import pandas_oracle as po
+
+D, N, F = 20, 10, 5
+NAMES = ["alpha_eq", "alpha_flx", "beta_long", "beta_short", "gamma_flx"]
+W = 5
+
+
+def make_panel(rng, nan_frac=0.08, universe_frac=0.1):
+    vals = rng.normal(size=(D, N))
+    vals[rng.uniform(size=(D, N)) < nan_frac] = np.nan
+    universe = rng.uniform(size=(D, N)) > universe_frac
+    return po.dense_to_long(vals, universe)
+
+
+def make_factors(rng):
+    universe = rng.uniform(size=(D, N)) > 0.1
+    cols = {}
+    for name in NAMES:
+        vals = rng.normal(size=(D, N))
+        vals[rng.uniform(size=(D, N)) < 0.08] = np.nan
+        cols[name] = po.dense_to_long(vals, universe)
+    return pd.DataFrame(cols)
+
+
+def test_single_factor_metrics_matches_oracle(rng):
+    from factormodeling_tpu.compat.factor_selector import single_factor_metrics
+
+    factors = make_factors(rng)
+    returns = make_panel(rng).rename("ret")
+    got = single_factor_metrics(factors, returns)
+    exp = po.o_single_factor_metrics(factors, returns)
+    exp = exp.sort_values("rank_IC_IR", ascending=False)
+    assert list(got.index) == list(exp.index)
+    for col in got.columns:
+        np.testing.assert_allclose(got[col].to_numpy(), exp[col].to_numpy(),
+                                   atol=1e-8, equal_nan=True)
+
+
+@pytest.mark.parametrize("method,kwargs", [
+    ("icir_top", {"icir_threshold": -5.0, "top_x": 3}),
+    ("momentum", {"max_weight": 0.6}),
+])
+def test_factor_selector_matches_oracle(rng, method, kwargs):
+    # dense universe: the O(D*F) rolling path is exact there; its ragged-
+    # universe window-straddle approximation is documented in selection/driver
+    from factormodeling_tpu.compat.factor_selector import FactorSelector
+
+    factors = make_factors(rng)
+    factors = factors.reindex(
+        pd.MultiIndex.from_product(
+            [sorted(set(factors.index.get_level_values("date"))),
+             sorted(set(factors.index.get_level_values("symbol")))],
+            names=["date", "symbol"]))
+    returns = make_panel(rng, universe_frac=0.0).rename("ret")
+    dates = sorted(set(factors.index.get_level_values("date")))
+    factor_ret = pd.DataFrame(rng.normal(scale=0.01, size=(len(dates), F)),
+                              index=pd.Index(dates, name="date"),
+                              columns=NAMES)
+    sel = FactorSelector(factors, returns, factor_ret, W, method, kwargs)
+    got = sel.prepare_selection()
+    assert sel.prepare_selection() is got  # cached
+    exp = po.o_rolling_selection(factors, returns, factor_ret, W, method,
+                                 kwargs)
+    assert list(got.index) == list(exp.index)
+    np.testing.assert_allclose(got.to_numpy(),
+                               exp[got.columns.tolist()].to_numpy(),
+                               atol=1e-8)
+
+
+def test_custom_plugin_path(rng):
+    from factormodeling_tpu.compat import factor_selector as fs
+
+    factors = make_factors(rng)
+    returns = make_panel(rng).rename("ret")
+    dates = sorted(set(factors.index.get_level_values("date")))
+    factor_ret = pd.DataFrame(rng.normal(size=(len(dates), F)),
+                              index=pd.Index(dates, name="date"), columns=NAMES)
+
+    def first_factor(metrics_df, *args, **kwargs):
+        w = pd.Series(0.0, index=metrics_df.index)
+        w[NAMES[0]] = 1.0
+        return w
+
+    fs.FACTOR_SELECTION_METHODS["first"] = first_factor
+    try:
+        got = fs.FactorSelector(factors, returns, factor_ret, W,
+                                "first").prepare_selection()
+    finally:
+        del fs.FACTOR_SELECTION_METHODS["first"]
+    assert (got[NAMES[0]] == 1.0).all()
+    assert got.drop(columns=NAMES[0]).to_numpy().sum() == 0
+    assert len(got) == len(sorted(set(dates) & set(factor_ret.index))) - W - 1
+
+
+@pytest.mark.parametrize("method", ["zscore", "rank"])
+def test_composite_static_matches_oracle(rng, method):
+    from factormodeling_tpu.compat.composite_factor import (
+        composite_factor_calculation)
+
+    factors = make_factors(rng)
+    got = composite_factor_calculation(factors, NAMES, method)
+    exp = po.o_composite_static(factors, NAMES, method)
+    assert got.index.equals(factors.index)
+    np.testing.assert_allclose(got.to_numpy(),
+                               exp.reindex(got.index).to_numpy(),
+                               atol=1e-8, equal_nan=True)
+
+
+@pytest.mark.parametrize("method", ["zscore", "rank"])
+def test_composite_weighted_matches_oracle(rng, method):
+    from factormodeling_tpu.compat.composite_factor import (
+        weighted_composite_factor)
+
+    factors = make_factors(rng)
+    dates = sorted(set(factors.index.get_level_values("date")))
+    sel = pd.DataFrame(rng.uniform(size=(len(dates) - 6, F)),
+                       index=pd.Index(dates[3:-3], name="date"), columns=NAMES)
+    sel.iloc[1] = 0.0  # a no-selection day
+    sel = sel.div(sel.sum(axis=1).where(lambda s: s > 0, 1.0), axis=0)
+    got = weighted_composite_factor(factors, sel, method)
+    exp = po.o_composite_weighted(factors, sel, method)
+    assert got.index.equals(factors.index)
+    np.testing.assert_allclose(got.to_numpy(),
+                               exp.reindex(got.index).to_numpy(),
+                               atol=1e-8, equal_nan=True)
+
+
+def market_data(rng):
+    returns = make_panel(rng, nan_frac=0.05).rename("ret")
+    idx = returns.index
+    cap = pd.Series(rng.integers(1, 4, size=len(idx)).astype(float), index=idx,
+                    name="cap")
+    invest = pd.Series(1.0, index=idx, name="inv")
+    return returns, cap, invest
+
+
+@pytest.mark.parametrize("method,kw", [
+    ("equal", dict(pct=0.3)),
+    ("linear", dict(max_weight=0.25)),
+])
+def test_simulation_matches_oracle(rng, method, kw):
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation, SimulationSettings)
+
+    returns, cap, invest = market_data(rng)
+    signal = make_panel(rng).reindex(returns.index)
+    factors_df = pd.DataFrame({"sig": signal})
+    settings = SimulationSettings(returns=returns, cap_flag=cap,
+                                  investability_flag=invest,
+                                  factors_df=factors_df, method=method,
+                                  plot=False, output_returns=True, **kw)
+    sim = Simulation("sig2", signal, settings)
+    result = sim.run()
+    assert "sig2" in factors_df.columns  # reference side effect preserved
+
+    # the reference keeps NaN-signal cells in the day's index at weight 0
+    # (they shape the per-symbol shift), so no dropna here
+    w_exp, counts_exp = po.o_daily_trade_list(
+        signal * invest, method, returns=returns, **kw)
+    res_exp = po.o_daily_portfolio_returns(w_exp, returns, cap)
+
+    res_sorted = result.sort_values("date").set_index("date")
+    for col in ["log_return", "long_return", "short_return", "turnover"]:
+        np.testing.assert_allclose(
+            res_sorted[col].to_numpy(),
+            res_exp.sort_index()[col].reindex(res_sorted.index).to_numpy(),
+            atol=1e-9)
+
+    w_got, counts_got = sim._daily_trade_list()
+    merged = pd.concat([w_got.rename("g"), w_exp.rename("e")], axis=1)
+    merged = merged.dropna(how="all")
+    np.testing.assert_allclose(merged["g"].fillna(0.0).to_numpy(),
+                               merged["e"].fillna(0.0).to_numpy(), atol=1e-9)
+    np.testing.assert_array_equal(
+        counts_got["long_count"].to_numpy(),
+        counts_exp["long_count"].reindex(counts_got.index).to_numpy())
+
+
+def test_analyzer_matches_oracle(rng):
+    from factormodeling_tpu.compat.portfolio_analyzer import PortfolioAnalyzer
+
+    dates = pd.date_range("2020-01-02", periods=D, freq="B")
+    df = pd.DataFrame({
+        "date": dates,
+        "log_return": rng.normal(scale=0.01, size=D),
+        "long_return": rng.normal(scale=0.01, size=D),
+        "short_return": rng.normal(scale=0.01, size=D),
+        "long_turnover": rng.uniform(size=D),
+        "short_turnover": rng.uniform(size=D),
+        "turnover": rng.uniform(size=D),
+    })
+    pa = PortfolioAnalyzer(df)
+    exp = po.o_analyzer_metrics(df)
+    np.testing.assert_allclose(pa.sharpe_ratio(), exp["sharpe"], rtol=1e-10)
+    np.testing.assert_allclose(pa.max_drawdown(), exp["max_drawdown"], rtol=1e-10)
+    np.testing.assert_allclose(pa.annualized_return(), exp["annualized_return"],
+                               rtol=1e-10)
+    assert set(pa.summary()) >= {"Sharpe Ratio", "Max Drawdown"}
+
+
+def test_multimanager_matches_oracle(rng):
+    from factormodeling_tpu.compat import multi_manager as mm
+    from factormodeling_tpu.compat.portfolio_simulation import SimulationSettings
+
+    returns, cap, invest = market_data(rng)
+    factors = make_factors(rng).reindex(returns.index)
+    dates = sorted(set(returns.index.get_level_values("date")))
+    fw = pd.DataFrame(rng.uniform(size=(len(dates), 3)),
+                      index=pd.Index(dates, name="date"), columns=NAMES[:3])
+    fw.iloc[2] = 0.0
+    fw = fw.div(fw.sum(axis=1).where(lambda s: s > 0, 1.0), axis=0)
+
+    settings = SimulationSettings(returns=returns, cap_flag=cap,
+                                  investability_flag=invest,
+                                  factors_df=factors, method="equal", pct=0.3,
+                                  plot=False)
+    result, top_l, top_s, counts = mm.run_multimanager_backtest(
+        factors, returns, cap, fw, settings)
+
+    exp_w, exp_counts = po.o_multimanager(factors, fw, method="equal", pct=0.3)
+    exp_res = po.o_daily_portfolio_returns(exp_w, returns, cap)
+    got = result.sort_values("date").set_index("date")
+    for col in ["log_return", "turnover"]:
+        np.testing.assert_allclose(
+            got[col].to_numpy(),
+            exp_res.sort_index()[col].reindex(got.index).to_numpy(), atol=1e-9)
+    np.testing.assert_allclose(
+        counts["long_count"].to_numpy(),
+        exp_counts["long_count"].reindex(counts.index).to_numpy(), atol=1e-9)
+
+
+def test_daily_trade_list_ignores_investability_when_called_directly(rng):
+    """The reference masks by investability only inside run(); direct callers
+    like multi_manager trade the raw signal."""
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation, SimulationSettings)
+
+    returns, cap, _ = market_data(rng)
+    invest = pd.Series(0.0, index=returns.index)  # nothing investable
+    signal = make_panel(rng, universe_frac=0.0).reindex(returns.index)
+    settings = SimulationSettings(returns=returns, cap_flag=cap,
+                                  investability_flag=invest, factors_df=None,
+                                  method="equal", pct=0.3, plot=False)
+    w, counts = Simulation("sig", signal, settings)._daily_trade_list()
+    assert counts["long_count"].sum() > 0  # raw signal traded
+    w_exp, _ = po.o_daily_trade_list(signal, "equal", pct=0.3)
+    merged = pd.concat([w.rename("g"), w_exp.rename("e")], axis=1)
+    np.testing.assert_allclose(merged["g"].fillna(0.0).to_numpy(),
+                               merged["e"].fillna(0.0).to_numpy(), atol=1e-9)
+
+
+def test_momentum_plugin_clip_guard():
+    """max_weight=1.0 (default) must NOT cap the window-sum before
+    normalization (reference guards the upper clip with max_weight < 1)."""
+    from factormodeling_tpu.compat.factor_selection_methods import (
+        factor_momentum_selector)
+
+    fr = pd.DataFrame({"a": [1.5], "b": [0.5]})
+    w = factor_momentum_selector(None, None, None, fr, 0, [0])
+    np.testing.assert_allclose(w.to_numpy(), [0.75, 0.25])
+    w_capped = factor_momentum_selector(None, None, None, fr, 0, [0],
+                                        max_weight=0.9)
+    np.testing.assert_allclose(w_capped.to_numpy(), [0.9 / 1.4, 0.5 / 1.4])
+
+
+def test_plugin_receives_window_date_list(rng):
+    from factormodeling_tpu.compat import factor_selector as fs
+
+    factors = make_factors(rng)
+    returns = make_panel(rng).rename("ret")
+    dates = sorted(set(factors.index.get_level_values("date")))
+    factor_ret = pd.DataFrame(rng.normal(size=(len(dates), F)),
+                              index=pd.Index(dates, name="date"), columns=NAMES)
+    seen = []
+
+    def probe(metrics_df, f_win, r_win, fr_win, today, window_dates, **kw):
+        seen.append((today, list(window_dates)))
+        return pd.Series(1.0, index=metrics_df.index)
+
+    fs.FACTOR_SELECTION_METHODS["probe"] = probe
+    try:
+        fs.FactorSelector(factors, returns, factor_ret, W,
+                          "probe").prepare_selection()
+    finally:
+        del fs.FACTOR_SELECTION_METHODS["probe"]
+    today0, win0 = seen[0]
+    assert win0 == dates[:W] and today0 == dates[W]
+    assert all(len(w) == W and today not in w for today, w in seen)
+
+
+def test_multimanager_nan_weight_counts_and_full_count_index(rng):
+    from factormodeling_tpu.compat import multi_manager as mm
+    from factormodeling_tpu.compat.portfolio_simulation import SimulationSettings
+
+    returns, cap, invest = market_data(rng)
+    factors = make_factors(rng).reindex(returns.index)
+    dates = sorted(set(returns.index.get_level_values("date")))
+    extra = max(dates) + 1  # a factor_weights date with no factor data
+    fw = pd.DataFrame(1.0 / 3, index=pd.Index(dates + [extra], name="date"),
+                      columns=NAMES[:3])
+    fw.iloc[5, 0] = np.nan
+    settings = SimulationSettings(returns=returns, cap_flag=cap,
+                                  investability_flag=invest,
+                                  factors_df=factors, method="equal", pct=0.3,
+                                  plot=False)
+    w, counts = mm.compute_multimanager_weights(factors, fw, settings)
+    assert list(counts.index) == list(fw.index)  # every fw date present
+    assert counts.loc[extra].tolist() == [0.0, 0.0]
+    assert np.isnan(counts.loc[dates[5], "long_count"])  # NaN fw poisons
+    # ...but the NaN weight contributes 0 to the combined book
+    day5 = w.xs(dates[5], level="date")
+    assert np.isfinite(day5.to_numpy()).all()
