@@ -1,0 +1,229 @@
+"""Oracle tests for the statistical risk model (factormodeling_tpu/risk.py).
+
+Ground truth is numpy: SVD of the demeaned panel for PCA, pandas-style
+pairwise-complete covariance re-derived with loops for factor_covariance.
+Covers BASELINE.json configs[3].
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from factormodeling_tpu.risk import (
+    ewma_weights,
+    factor_covariance,
+    full_covariance,
+    pca,
+    portfolio_variance,
+    risk_matvec,
+    statistical_risk_model,
+)
+
+
+def _panel(rng, d, n, nan_frac=0.0, n_factors=3):
+    """Low-rank-plus-noise return panel with an interesting spectrum."""
+    b = rng.normal(size=(n, n_factors))
+    f = rng.normal(scale=(0.05, 0.02, 0.01)[:n_factors], size=(d, n_factors))
+    x = f @ b.T + rng.normal(scale=0.005, size=(d, n))
+    if nan_frac:
+        x[rng.uniform(size=x.shape) < nan_frac] = np.nan
+    return x.astype(np.float64)
+
+
+def _np_pca(x, k):
+    """Numpy oracle: mean-impute NaNs, demean, SVD."""
+    mu = np.nanmean(x, axis=0)
+    c = np.where(np.isnan(x), 0.0, x - mu)
+    u, s, vt = np.linalg.svd(c, full_matrices=False)
+    return vt[:k], (s[:k] ** 2) / (x.shape[0] - 1), mu
+
+
+@pytest.mark.parametrize("d,n", [(40, 100), (100, 40)])  # dual + primal paths
+def test_pca_eigh_matches_numpy_svd(rng, d, n):
+    x = _panel(rng, d, n)
+    k = 5
+    res = pca(jnp.asarray(x), k, method="eigh")
+    comps_np, ev_np, mu_np = _np_pca(x, k)
+    np.testing.assert_allclose(np.asarray(res.explained_variance), ev_np,
+                               rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(res.mean), mu_np, rtol=1e-10)
+    # components match up to sign
+    got = np.asarray(res.components)
+    for i in range(k):
+        dot = abs(np.dot(got[i], comps_np[i]))
+        np.testing.assert_allclose(dot, 1.0, atol=1e-6)
+
+
+def test_pca_handles_nans(rng):
+    x = _panel(rng, 60, 80, nan_frac=0.05)
+    res = pca(jnp.asarray(x), 4, method="eigh")
+    comps_np, ev_np, _ = _np_pca(x, 4)
+    np.testing.assert_allclose(np.asarray(res.explained_variance), ev_np,
+                               rtol=1e-8)
+    got = np.asarray(res.components)
+    for i in range(4):
+        assert abs(np.dot(got[i], comps_np[i])) > 1.0 - 1e-6
+
+
+def test_pca_randomized_approximates_exact(rng):
+    x = _panel(rng, 120, 300)
+    exact = pca(jnp.asarray(x), 3, method="eigh")
+    approx = pca(jnp.asarray(x), 3, method="randomized", oversample=10,
+                 iters=6, seed=7)
+    np.testing.assert_allclose(np.asarray(approx.explained_variance),
+                               np.asarray(exact.explained_variance), rtol=1e-4)
+    for i in range(3):
+        dot = abs(np.dot(np.asarray(approx.components[i]),
+                         np.asarray(exact.components[i])))
+        assert dot > 1.0 - 1e-4
+
+
+def test_risk_model_full_rank_recovers_sample_cov(rng):
+    # with k = rank, B diag(f) B^T alone is the sample covariance of the
+    # mean-imputed panel; idio collapses to the floor
+    d, n = 80, 30
+    x = _panel(rng, d, n)
+    model = statistical_risk_model(jnp.asarray(x), k=n, method="eigh")
+    mu = x.mean(axis=0)
+    c = x - mu
+    sample = c.T @ c / (d - 1)
+    np.testing.assert_allclose(np.asarray(full_covariance(model)), sample,
+                               atol=1e-8)
+
+
+def test_risk_model_matvec_and_variance_agree_with_dense(rng):
+    x = _panel(rng, 100, 50, nan_frac=0.02)
+    model = statistical_risk_model(jnp.asarray(x), k=5)
+    sigma = np.asarray(full_covariance(model))
+    w = rng.normal(size=(7, 50))
+    np.testing.assert_allclose(np.asarray(risk_matvec(model, jnp.asarray(w))),
+                               w @ sigma, rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(portfolio_variance(model, jnp.asarray(w))),
+        np.einsum("bi,ij,bj->b", w, sigma, w), rtol=1e-6)
+    assert (np.asarray(model.idio_var) > 0).all()
+
+
+def test_risk_model_variance_decomposition(rng):
+    # diag(Sigma_model) should reproduce per-asset total variance of the panel
+    d, n = 200, 40
+    x = _panel(rng, d, n)
+    model = statistical_risk_model(jnp.asarray(x), k=3, method="eigh")
+    total = np.asarray(full_covariance(model)).diagonal()
+    sample_var = x.var(axis=0, ddof=1)
+    np.testing.assert_allclose(total, sample_var, rtol=1e-6)
+
+
+def test_factor_covariance_matches_pandas_pairwise(rng):
+    x = _panel(rng, 60, 8, nan_frac=0.15)
+    got = np.asarray(factor_covariance(jnp.asarray(x)))
+    want = pd.DataFrame(x).cov().to_numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-12)
+
+
+def test_factor_covariance_dense_matches_numpy(rng):
+    x = _panel(rng, 50, 6)
+    got = np.asarray(factor_covariance(jnp.asarray(x)))
+    want = np.cov(x, rowvar=False, ddof=1)
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_factor_covariance_ewma_weights(rng):
+    d = 40
+    x = _panel(rng, d, 5)
+    w = np.asarray(ewma_weights(d, halflife=10.0, dtype=jnp.float64))
+    got = np.asarray(factor_covariance(jnp.asarray(x), weights=jnp.asarray(w)))
+    # numpy oracle: reliability-weighted covariance
+    mu = (w[:, None] * x).sum(0) / w.sum()
+    c = x - mu
+    v1, v2 = w.sum(), (w * w).sum()
+    want = (w[:, None] * c).T @ c / (v1 - v2 / v1)
+    np.testing.assert_allclose(got, want, rtol=1e-8)
+    assert w[-1] == w.max()  # most recent date heaviest
+
+
+def test_factor_covariance_ewma_pairwise_with_nans(rng):
+    # exercises the per-pair reliability-weights correction (m2/V2 term)
+    # against a looped per-pair oracle — dense panels can't distinguish it
+    d, f = 50, 5
+    x = _panel(rng, d, f, nan_frac=0.2)
+    w = np.asarray(ewma_weights(d, halflife=12.0, dtype=jnp.float64))
+    got = np.asarray(factor_covariance(jnp.asarray(x), weights=jnp.asarray(w)))
+    want = np.full((f, f), np.nan)
+    for i in range(f):
+        for j in range(f):
+            m = ~np.isnan(x[:, i]) & ~np.isnan(x[:, j])
+            wj = w[m]
+            v1, v2 = wj.sum(), (wj * wj).sum()
+            den = v1 - v2 / v1
+            if den <= 0:
+                continue
+            mi = (wj * x[m, i]).sum() / v1
+            mj = (wj * x[m, j]).sum() / v1
+            want[i, j] = (wj * (x[m, i] - mi) * (x[m, j] - mj)).sum() / den
+    np.testing.assert_allclose(got, want, rtol=1e-8)
+
+
+def test_risk_model_idio_var_unbiased_under_nans(rng):
+    # idio_var must not count projection leakage at mean-imputed cells
+    d, n = 400, 30
+    x = _panel(rng, d, n, nan_frac=0.3)
+    model = statistical_risk_model(jnp.asarray(x), k=3, method="eigh")
+    total = np.asarray(full_covariance(model)).diagonal()
+    sample_var = np.nanvar(x, axis=0, ddof=1)
+    np.testing.assert_allclose(total, sample_var, rtol=0.35)
+    assert np.median(total / sample_var) < 1.3
+
+
+def test_factor_covariance_shrinkage_pulls_to_diagonal(rng):
+    x = _panel(rng, 50, 6)
+    raw = np.asarray(factor_covariance(jnp.asarray(x)))
+    shrunk = np.asarray(factor_covariance(jnp.asarray(x), shrinkage=0.5))
+    target = np.nanmean(np.diag(raw)) * np.eye(6)
+    np.testing.assert_allclose(shrunk, 0.5 * raw + 0.5 * target, rtol=1e-8)
+    full = np.asarray(factor_covariance(jnp.asarray(x), shrinkage=1.0))
+    np.testing.assert_allclose(full, target, rtol=1e-8, atol=1e-12)
+
+
+def test_factor_covariance_insufficient_overlap_is_nan(rng):
+    x = np.full((6, 3), np.nan)
+    x[:, 0] = rng.normal(size=6)
+    x[0, 1] = 1.0  # single observation: 0 dof
+    got = np.asarray(factor_covariance(jnp.asarray(x)))
+    assert np.isfinite(got[0, 0])
+    assert np.isnan(got[0, 1]) and np.isnan(got[1, 1]) and np.isnan(got[2, 2])
+
+
+def test_pca_rank_deficient_zero_modes_are_zeroed(rng):
+    # 40 dates but only 10 distinct rows: rank <= 10 (and demeaning zeroes
+    # one more gram mode). Degenerate dual-path modes must come back as
+    # zero rows, not garbage directions scaled by 1/sqrt(1e-30).
+    base = rng.normal(size=(10, 100))
+    x = np.repeat(base, 4, axis=0)  # [40, 100], rank 10
+    res = pca(jnp.asarray(x), k=40, method="eigh")
+    norms = np.linalg.norm(np.asarray(res.components), axis=1)
+    assert np.all((np.abs(norms - 1.0) < 1e-6) | (norms < 1e-6))
+    ev = np.asarray(res.explained_variance)
+    assert np.all(ev[norms < 1e-6] == 0.0)
+
+    model = statistical_risk_model(jnp.asarray(x), k=40, method="eigh")
+    idio = np.asarray(model.idio_var)
+    assert np.all(idio <= x.var(axis=0, ddof=1) + 1e-6)
+
+
+def test_factor_covariance_ledoit_wolf_rejects_weights(rng):
+    x = _panel(rng, 30, 4)
+    with pytest.raises(ValueError, match="ledoit_wolf"):
+        factor_covariance(jnp.asarray(x), method="ledoit_wolf",
+                          weights=ewma_weights(30, 10.0))
+
+
+def test_factor_covariance_ledoit_wolf_path(rng):
+    x = _panel(rng, 80, 6)
+    got = np.asarray(factor_covariance(jnp.asarray(x), method="ledoit_wolf"))
+    sample = np.cov(x, rowvar=False, ddof=1)
+    # shrunk toward constant-correlation target: SPD, same diagonal scale
+    assert np.allclose(got, got.T)
+    assert (np.linalg.eigvalsh(got) > 0).all()
+    np.testing.assert_allclose(np.diag(got), np.diag(sample), rtol=0.5)
