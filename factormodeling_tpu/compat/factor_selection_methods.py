@@ -92,4 +92,10 @@ def mvo_selector(metrics_df, factors_win, returns_win, factor_ret_win, today,
     w = np.asarray(res.x, dtype=float)
     if not np.all(np.isfinite(w)):
         w = np.zeros(f)
-    return pd.Series(np.maximum(w, 0.0), index=cols, name=today)
+    vec = pd.Series(np.maximum(w, 0.0), index=cols, name=today)
+    # Reference tail (``factor_selection_methods.py:172-174``): renormalize
+    # when the sum is positive, so direct plugin callers get sum-1 weights.
+    # (The clamp above only sweeps ADMM's ~1e-8 box violations to zero.)
+    if vec.sum() > 0:
+        vec = vec / vec.sum()
+    return vec

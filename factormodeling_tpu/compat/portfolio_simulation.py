@@ -166,17 +166,30 @@ class Simulation:
         The turnover diff runs over the dates *present in the weights index*
         — the reference unstacks the long weights, so a date whose rows were
         all dropped (e.g. an all-zero multimanager day) is skipped by
-        ``.diff()`` rather than traded through."""
+        ``.diff()`` rather than traded through.
+
+        The result frame spans the *union* of weight dates and return dates:
+        the reference's ``(longs * r_df)`` / cost alignment (``:763-775``)
+        emits a row for every returns date, with 0.0 leg returns and NaN
+        turnover where no weights exist (e.g. the pre-window head of a
+        multimanager backtest)."""
         w_dates = pd.Index(
             level_values(weights.index, "date", 0).unique()).sort_values()
         vocab = PanelVocab(w_dates, self._vocab.symbols)
         wv, _ = vocab.densify(weights)
         s = self._dense_settings(np.ones(vocab.shape, dtype=bool), vocab)
         res = _dense_pnl(jnp.asarray(wv), s)
-        result = pd.DataFrame({"date": vocab.dates,
-                               **{c: np.asarray(getattr(res, c))
-                                  for c in _RESULT_COLUMNS}})
-        result = (result.sort_values("date", ascending=False)
+        result = pd.DataFrame({c: np.asarray(getattr(res, c))
+                               for c in _RESULT_COLUMNS},
+                              index=pd.Index(vocab.dates, name="date"))
+        r_dates = pd.Index(level_values(self.returns.index, "date", 0).unique())
+        all_dates = w_dates.union(r_dates).sort_values()
+        if not all_dates.equals(pd.Index(vocab.dates)):
+            result = result.reindex(all_dates)
+            ret_cols = ["log_return", "long_return", "short_return"]
+            result[ret_cols] = result[ret_cols].fillna(0.0)
+        result = (result.rename_axis("date").reset_index()
+                  .sort_values("date", ascending=False)
                   .reset_index(drop=True))
         if self.contributor:
             longs = pd.Series(np.asarray(res.long_pnl_by_name),

@@ -149,8 +149,16 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
 
     jitted = jax.jit(step, in_shardings=in_shardings)
 
+    d_size = mesh.shape[date_axis]
+
     def shard_inputs(factors, returns, factor_ret, cap_flag, investability,
                      universe):
+        if returns.shape[0] % d_size:
+            raise ValueError(
+                f"{returns.shape[0]} dates are not divisible by the mesh's "
+                f"'{date_axis}' axis ({d_size}); pad the date axis (all-NaN "
+                f"rows, universe=False) or pick a mesh whose date axis "
+                f"divides D")
         args = (factors, returns, factor_ret, cap_flag, investability, universe)
         return tuple(jax.device_put(a, s) for a, s in zip(args, in_shardings))
 

@@ -34,7 +34,10 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from factormodeling_tpu.selection.shrinkage import ledoit_wolf_shrinkage
+from factormodeling_tpu.selection.shrinkage import (
+    ledoit_wolf_shrinkage,
+    masked_pairwise_cov,
+)
 
 __all__ = [
     "PCAResult",
@@ -139,21 +142,7 @@ def factor_covariance(factor_returns: jnp.ndarray, *,
         filled = jnp.where(valid, x, mu[None, :])
         cov = ledoit_wolf_shrinkage(filled)
     elif method == "sample":
-        vf = valid.astype(x.dtype)
-        m = vf if weights is None else vf * weights[:, None]
-        x0 = jnp.where(valid, x, 0.0)
-        xw = x0 if weights is None else x0 * weights[:, None]
-        v1 = m.T @ vf                             # joint weight sums     [F, F]
-        sx = xw.T @ vf                            # joint sums of x_i     [F, F]
-        sxy = xw.T @ x0                           # joint cross products  [F, F]
-        if weights is None:
-            den = v1 - ddof
-        else:
-            m2 = (m * weights[:, None]).T @ vf    # joint V2 sums
-            den = v1 - m2 / jnp.where(v1 > 0, v1, jnp.nan)
-        num = sxy - sx * sx.T / jnp.where(v1 > 0, v1, jnp.nan)
-        cov = num / jnp.where(den > 0, den, jnp.nan)
-        cov = 0.5 * (cov + cov.T)
+        cov = masked_pairwise_cov(x, weights=weights, ddof=ddof)
     else:
         raise ValueError(f"unknown covariance method: {method!r}")
 

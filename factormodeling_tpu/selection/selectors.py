@@ -22,7 +22,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from factormodeling_tpu.selection.shrinkage import ledoit_wolf_shrinkage
+from factormodeling_tpu.selection.shrinkage import (
+    ledoit_wolf_shrinkage,
+    masked_pairwise_cov,
+)
 from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_dense
 
 __all__ = [
@@ -111,18 +114,11 @@ def mvo_selector(ctx: SelectionContext, *, risk_aversion: float = 1.0,
         mu = jnp.nanmean(win, axis=0)
         if use_shrinkage:
             cov = ledoit_wolf_shrinkage(win)
+            cov = 0.5 * (cov + cov.T)
         else:
             # pandas DataFrame.cov(): pairwise-complete over jointly-valid
             # rows with per-pair means, ddof=1 — NaNs must not poison it
-            valid = (~jnp.isnan(win)).astype(ret.dtype)
-            x0 = jnp.where(jnp.isnan(win), 0.0, win)
-            n_pair = valid.T @ valid
-            sxy = x0.T @ x0
-            sx = x0.T @ valid   # sum of column i over rows where j is valid
-            ns = jnp.where(n_pair > 0, n_pair, jnp.nan)
-            cov = (sxy - sx * sx.T / ns) / jnp.where(n_pair > 1, n_pair - 1.0,
-                                                     jnp.nan)
-        cov = 0.5 * (cov + cov.T)
+            cov = masked_pairwise_cov(win)
         prob = BoxQPProblem(
             q=-mu, lo=jnp.zeros(f, ret.dtype), hi=jnp.full(f, cap, ret.dtype),
             E=jnp.ones((1, f), ret.dtype), b=jnp.ones(1, ret.dtype),

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["ledoit_wolf_shrinkage"]
+__all__ = ["ledoit_wolf_shrinkage", "masked_pairwise_cov"]
 
 
 def ledoit_wolf_shrinkage(returns: jnp.ndarray) -> jnp.ndarray:
@@ -44,3 +44,33 @@ def ledoit_wolf_shrinkage(returns: jnp.ndarray) -> jnp.ndarray:
     lam = jnp.where(d > 0, phi / d, 1.0)
     lam = jnp.clip(lam, 0.0, 1.0)
     return lam * target + (1.0 - lam) * sample
+
+
+def masked_pairwise_cov(x: jnp.ndarray,
+                        weights: jnp.ndarray | None = None,
+                        ddof: int = 1) -> jnp.ndarray:
+    """pandas ``DataFrame.cov()`` semantics on device: pairwise-complete
+    covariance of ``x [T, F]`` with NaN holes.
+
+    Entry (i, j) uses only the rows where both columns are valid, with means
+    computed over that joint sample — three ``[F, T] @ [T, F]`` matmuls, no
+    per-pair loops. Optional per-row reliability ``weights [T]`` switch the
+    denominator to the ``V1 - V2/V1`` bias correction (``ddof`` ignored).
+    Pairs whose denominator is non-positive come back NaN.
+    """
+    valid = ~jnp.isnan(x)
+    vf = valid.astype(x.dtype)
+    m = vf if weights is None else vf * weights[:, None]
+    x0 = jnp.where(valid, x, 0.0)
+    xw = x0 if weights is None else x0 * weights[:, None]
+    v1 = m.T @ vf                             # joint weight sums     [F, F]
+    sx = xw.T @ vf                            # joint sums of x_i     [F, F]
+    sxy = xw.T @ x0                           # joint cross products  [F, F]
+    if weights is None:
+        den = v1 - ddof
+    else:
+        m2 = (m * weights[:, None]).T @ vf    # joint V2 sums
+        den = v1 - m2 / jnp.where(v1 > 0, v1, jnp.nan)
+    num = sxy - sx * sx.T / jnp.where(v1 > 0, v1, jnp.nan)
+    cov = num / jnp.where(den > 0, den, jnp.nan)
+    return 0.5 * (cov + cov.T)

@@ -319,3 +319,67 @@ def test_multimanager_nan_weight_counts_and_full_count_index(rng):
     # ...but the NaN weight contributes 0 to the combined book
     day5 = w.xs(dates[5], level="date")
     assert np.isfinite(day5.to_numpy()).all()
+
+
+def test_result_spans_union_of_weight_and_return_dates(rng):
+    # Reference ``_daily_portfolio_returns`` aligns ``longs * r_df`` on the
+    # union of weight and return dates (portfolio_simulation.py:763-775):
+    # return-only dates get 0.0 leg returns and NaN turnover. A multimanager
+    # backtest's weights cover only dates[window:-1], so those zero rows
+    # dilute analyzer stats and must be present.
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation, SimulationSettings)
+
+    returns, cap, invest = market_data(rng)
+    dates = sorted(set(returns.index.get_level_values("date")))
+    keep = dates[6:-3]  # signal misses the head and the tail of the history
+    signal = make_panel(rng).reindex(returns.index)
+    signal = signal[signal.index.get_level_values("date").isin(keep)]
+    settings = SimulationSettings(returns=returns, cap_flag=cap,
+                                  investability_flag=invest, factors_df=None,
+                                  method="equal", pct=0.3, plot=False,
+                                  output_returns=True)
+    sim = Simulation("sig", signal, settings)
+    result = sim.run()
+
+    res_sorted = result.sort_values("date").set_index("date")
+    assert list(res_sorted.index) == dates  # every returns date has a row
+
+    # reference :73 multiplies signal * invest with pandas *union* alignment,
+    # extending the signal to every invest date (NaN values there)
+    w_exp, _ = po.o_daily_trade_list(signal * invest, "equal",
+                                     returns=returns, pct=0.3)
+    res_exp = po.o_daily_portfolio_returns(w_exp, returns, cap).sort_index()
+    for col in ["log_return", "long_return", "short_return"]:
+        np.testing.assert_allclose(
+            res_sorted[col].to_numpy(),
+            res_exp[col].reindex(res_sorted.index).to_numpy(), atol=1e-9)
+    # turnover is NaN exactly where the oracle's (weight-date-only) diff is
+    for col in ["long_turnover", "short_turnover", "turnover"]:
+        exp = res_exp[col].reindex(res_sorted.index)
+        got = res_sorted[col]
+        assert np.array_equal(np.isnan(got.to_numpy()), np.isnan(exp.to_numpy()))
+        np.testing.assert_allclose(got.dropna().to_numpy(),
+                                   exp.dropna().to_numpy(), atol=1e-9)
+
+    # The multimanager pattern calls _daily_portfolio_returns directly with
+    # weights over a strict date subset — the path where the union reindex
+    # actually fires (run() above union-extends the signal, so its weights
+    # already span every date).
+    w_all, _ = sim._daily_trade_list()
+    sub = [d for d in dates if dates[10] <= d <= dates[15]]
+    w_sub = w_all[w_all.index.get_level_values("date").isin(sub)]
+    res_sub, _, _ = sim._daily_portfolio_returns(w_sub)
+    sub_sorted = res_sub.sort_values("date").set_index("date")
+    assert list(sub_sorted.index) == dates
+    exp_sub = po.o_daily_portfolio_returns(w_sub, returns, cap).sort_index()
+    for col in ["log_return", "long_return", "short_return"]:
+        exp = exp_sub[col].reindex(sub_sorted.index).fillna(0.0)
+        np.testing.assert_allclose(sub_sorted[col].to_numpy(),
+                                   exp.to_numpy(), atol=1e-9)
+    for col in ["long_turnover", "short_turnover", "turnover"]:
+        exp = exp_sub[col].reindex(sub_sorted.index)
+        got = sub_sorted[col]
+        assert np.array_equal(np.isnan(got.to_numpy()), np.isnan(exp.to_numpy()))
+        np.testing.assert_allclose(got.dropna().to_numpy(),
+                                   exp.dropna().to_numpy(), atol=1e-9)
