@@ -1,5 +1,9 @@
 """Backtest engine (L4). Reference surface: ``portfolio_simulation.py``."""
 
+from factormodeling_tpu.backtest.diagnostics import (  # noqa: F401
+    SolverDiagnostics,
+    check_anomalies,
+)
 from factormodeling_tpu.backtest.engine import (  # noqa: F401
     SimulationOutput,
     daily_trade_list,

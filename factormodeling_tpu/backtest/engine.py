@@ -19,6 +19,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from factormodeling_tpu.backtest.diagnostics import SolverDiagnostics
 from factormodeling_tpu.backtest.mvo import mvo_turnover_weights, mvo_weights
 from factormodeling_tpu.backtest.pnl import DailyResult, daily_portfolio_returns
 from factormodeling_tpu.backtest.settings import SimulationSettings
@@ -33,25 +34,39 @@ class SimulationOutput(NamedTuple):
     long_count: jnp.ndarray    # [D]
     short_count: jnp.ndarray   # [D]
     result: DailyResult
+    diagnostics: SolverDiagnostics
 
 
 def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
     """Daily weights for the chosen scheme, shifted one day per symbol
-    (reference ``_daily_trade_list``). Returns (weights, long/short counts)."""
+    (reference ``_daily_trade_list``).
+
+    Returns ``(weights, long_count, short_count, diagnostics)``; the
+    :class:`SolverDiagnostics` carry the ADMM residual/acceptance for the QP
+    schemes and the pre-shift leg sums for all four."""
+    d = signal.shape[0]
+    nan_d = jnp.full((d,), jnp.nan, signal.dtype)
+    ok_d = jnp.ones((d,), bool)
     if s.method == "equal":
-        w, lc, sc = equal_weights(signal, s.pct)
+        (w, lc, sc), resid, ok = equal_weights(signal, s.pct), nan_d, ok_d
     elif s.method == "linear":
-        w, lc, sc = linear_weights(signal, s.max_weight)
+        (w, lc, sc), resid, ok = linear_weights(signal, s.max_weight), nan_d, ok_d
     elif s.method == "mvo":
-        w, lc, sc = mvo_weights(signal, s)
+        w, lc, sc, resid, ok = mvo_weights(signal, s)
     else:  # mvo_turnover
-        w, lc, sc = mvo_turnover_weights(signal, s)
+        w, lc, sc, resid, ok = mvo_turnover_weights(signal, s)
+
+    diag = SolverDiagnostics(
+        primal_residual=resid, solver_ok=ok,
+        long_sum=jnp.maximum(w, 0.0).sum(-1),
+        short_sum=jnp.minimum(w, 0.0).sum(-1),
+        active=(lc > 0) & (sc > 0))
 
     if s.universe is not None:
         shifted = masked_shift(w, s.universe, 1, axis=0)
     else:
         shifted = shift(w, 1, axis=0)
-    return shifted, lc, sc
+    return shifted, lc, sc, diag
 
 
 def run_simulation(signal: jnp.ndarray, s: SimulationSettings) -> SimulationOutput:
@@ -59,7 +74,7 @@ def run_simulation(signal: jnp.ndarray, s: SimulationSettings) -> SimulationOutp
     ``Simulation.run`` minus host-side printing/plotting, which live in
     :mod:`factormodeling_tpu.analytics`)."""
     masked = signal * s.investability_flag
-    weights, lc, sc = daily_trade_list(masked, s)
+    weights, lc, sc, diag = daily_trade_list(masked, s)
     result = daily_portfolio_returns(weights, s)
     return SimulationOutput(weights=weights, long_count=lc, short_count=sc,
-                            result=result)
+                            result=result, diagnostics=diag)

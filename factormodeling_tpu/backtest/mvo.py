@@ -84,7 +84,11 @@ def _x0_legs(signal_row: jnp.ndarray) -> jnp.ndarray:
 
 def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
                s: SimulationSettings, turnover: bool):
-    """One date's MVO solve with the full fallback ladder. Returns [N]."""
+    """One date's MVO solve with the full fallback ladder.
+
+    Returns ``(w [N], primal_residual [], solver_ok [])`` — the residual and
+    acceptance flag feed :class:`~factormodeling_tpu.backtest.diagnostics.
+    SolverDiagnostics`."""
     n = signal_row.shape[0]
     dtype = returns.dtype
     pos = signal_row > 0
@@ -131,7 +135,11 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     # covariance None (no history at all) -> equal-scheme fallback
     eq_row, _, _ = equal_weights(signal_row[None, :], s.pct)
     w = jnp.where(t_used >= 1, w, eq_row[0])
-    return w
+    # short-history days are the deterministic fallback ladder (reference
+    # handles them silently by design) — not an anomaly, and their discarded
+    # solve has no meaningful residual
+    resid = jnp.where(t_used >= 2, res.primal_residual, jnp.nan)
+    return w, resid, solver_ok | (t_used < 2)
 
 
 def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
@@ -145,8 +153,8 @@ def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
         return _solve_day(signal[today], s.returns, today, jnp.zeros(n, s.returns.dtype),
                           s, turnover=False)
 
-    w = lax.map(one, jnp.arange(d), batch_size=s.mvo_batch)
-    return _finalize(w, signal, s, pos, neg, flat)
+    w, resid, ok = lax.map(one, jnp.arange(d), batch_size=s.mvo_batch)
+    return _finalize(w, signal, s, pos, neg, flat, resid, ok)
 
 
 def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
@@ -159,12 +167,14 @@ def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
     zero_day = flat | (_universe_count(signal, s) < 2)
 
     def step(w_prev, today):
-        w = _solve_day(signal[today], s.returns, today, w_prev, s, turnover=True)
+        w, resid, ok = _solve_day(signal[today], s.returns, today, w_prev, s,
+                                  turnover=True)
         w = jnp.where(zero_day[today], 0.0, w)
-        return w, w
+        return w, (w, resid, ok)
 
-    _, w = lax.scan(step, jnp.zeros(n, s.returns.dtype), jnp.arange(d))
-    return _finalize(w, signal, s, pos, neg, flat)
+    _, (w, resid, ok) = lax.scan(step, jnp.zeros(n, s.returns.dtype),
+                                 jnp.arange(d))
+    return _finalize(w, signal, s, pos, neg, flat, resid, ok)
 
 
 def _universe_count(signal: jnp.ndarray, s: SimulationSettings):
@@ -173,7 +183,7 @@ def _universe_count(signal: jnp.ndarray, s: SimulationSettings):
     return jnp.full(signal.shape[:-1], signal.shape[-1])
 
 
-def _finalize(w, signal, s, pos, neg, flat):
+def _finalize(w, signal, s, pos, neg, flat, resid, ok):
     zero_day = flat | (_universe_count(signal, s) < 2)
     w = jnp.where(zero_day[..., None], 0.0, w)
     zero = jnp.zeros_like(pos.sum(-1))
@@ -186,4 +196,8 @@ def _finalize(w, signal, s, pos, neg, flat):
     k_short = jnp.maximum(jnp.floor(sc * s.pct), 1.0).astype(sc.dtype)
     lc = jnp.where(no_hist, k_long, lc)
     sc = jnp.where(no_hist, k_short, sc)
-    return w, jnp.where(zero_day, zero, lc), jnp.where(zero_day, zero, sc)
+    # flat / no-history days never reach the solver's accept branch; mark
+    # them ok so diagnostics only flag genuine solver fallbacks
+    ok = ok | zero_day | no_hist
+    return (w, jnp.where(zero_day, zero, lc), jnp.where(zero_day, zero, sc),
+            resid, ok)

@@ -24,6 +24,7 @@ from factormodeling_tpu.backtest import (
     SimulationSettings as _DenseSettings,
     daily_trade_list as _dense_trade_list,
 )
+from factormodeling_tpu.backtest.diagnostics import check_anomalies
 from factormodeling_tpu.backtest.pnl import daily_portfolio_returns as _dense_pnl
 from factormodeling_tpu.backtest.pnl import signal_metrics as _dense_signal_metrics
 from factormodeling_tpu.compat._convert import PanelVocab, level_values
@@ -148,7 +149,10 @@ class Simulation:
         trade the raw signal."""
         sig, uni = self._vocab.densify(self.custom_feature)
         s = self._dense_settings(uni)
-        w, lc, sc = _dense_trade_list(jnp.asarray(sig), s)
+        w, lc, sc, diag = _dense_trade_list(jnp.asarray(sig), s)
+        # replay the reference's runtime warnings (portfolio_simulation.py:
+        # 448-449 leg sums, :452-459 solver fallback) after the device pass
+        check_anomalies(diag, name=self.name)
         weights = self._vocab.to_series(np.asarray(w), uni, name="weight")
         sig_dates = pd.Index(
             level_values(self.custom_feature.index, "date", 0).unique())

