@@ -1,0 +1,262 @@
+"""End-to-end research pipeline on the compat API — the reference notebook's
+workflow as a headless script.
+
+Replays ``/root/reference/pipeline.ipynb`` (57 cells) stage by stage on the
+TPU-backed pandas surface, persisting every expensive stage through the
+parquet :class:`~factormodeling_tpu.io.ArtifactStore` the way the notebook
+writes ``data/*.csv`` (cells 8, 21-26):
+
+  1. load the three input schemas              (cells 4-5)
+  2. full-sample factor metrics                (cell 8)
+  3. static zscore/rank composites + ts_decay  (cells 10-18) + equal/linear sims
+  4. rolling selection: icir / momentum / mvo  (cells 21-23)
+  5. per-method weighted composites            (cells 25-26)
+  6. per-composite sims across all 4 schemes   (cells 30-49)
+  7. multi-manager backtest                    (cells 53-56)
+
+Run ``python examples/pipeline.py`` for a synthetic demo (no data needed), or
+point ``--data`` at a directory holding the reference's three CSVs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from functools import partial
+from pathlib import Path
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import pandas as pd
+
+
+def _force_cpu_if_requested(cpu: bool):
+    if cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+FEATURES_CSV = "2.symbol_features_long.csv"
+FACTORS_CSV = "8.factors_df.csv"
+FACTOR_RETURNS_CSV = "9.single_factor_returns.csv"
+
+
+def make_demo_data(data_dir: str | Path, *, n_dates=150, n_symbols=40,
+                   seed=12345) -> Path:
+    """Synthesize the three input schemas (reference cell 4) with the factor
+    naming convention ``<prefix>_<suffix>`` the composite blend keys on
+    (``composite_factor.py:158-184``): prefix = family, suffix in
+    {_eq, _flx, _long, _short}."""
+    data_dir = Path(data_dir)
+    data_dir.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    dates = pd.date_range("2020-01-02", periods=n_dates, freq="B")
+    symbols = [f"SYM{j:03d}" for j in range(n_symbols)]
+
+    names = ["mom_flx", "mom_eq", "val_flx", "val_long", "qual_flx",
+             "size_short"]
+    # latent per-factor exposures with some persistence, returns that load on
+    # them weakly -> realistic (noisy, small-IC) factor structure
+    expo = rng.normal(size=(len(names), n_dates, n_symbols))
+    for i in range(len(names)):
+        for t in range(1, n_dates):
+            expo[i, t] = 0.9 * expo[i, t - 1] + 0.44 * expo[i, t]
+    loadings = rng.normal(scale=0.003, size=len(names))
+    rets = (np.einsum("f,fdn->dn", loadings, expo)
+            + rng.normal(scale=0.02, size=(n_dates, n_symbols)))
+
+    keep = rng.uniform(size=(n_dates, n_symbols)) > 0.05  # ragged universe
+    didx, sidx = np.nonzero(keep)
+    features = pd.DataFrame({
+        "date": dates[didx], "symbol": np.asarray(symbols)[sidx],
+        "log_return": rets[didx, sidx],
+        "cap_flag": rng.integers(1, 4, size=didx.size).astype(float),
+        "investability_flag": 1.0,
+    })
+    factors = pd.DataFrame({
+        "date": dates[didx], "symbol": np.asarray(symbols)[sidx],
+        **{name: expo[i, didx, sidx] for i, name in enumerate(names)},
+    })
+    # per-date cross-sectional factor returns f.r/f.f (factor_selector.py:46)
+    fr = {}
+    for i, name in enumerate(names):
+        num = np.nansum(np.where(keep, expo[i] * rets, 0.0), axis=1)
+        den = np.nansum(np.where(keep, expo[i] ** 2, 0.0), axis=1)
+        fr[name] = num / np.where(den > 0, den, np.nan)
+    factor_returns = pd.DataFrame({"date": dates, **fr})
+
+    features.to_csv(data_dir / FEATURES_CSV, index=False)
+    factors.to_csv(data_dir / FACTORS_CSV, index=False)
+    factor_returns.to_csv(data_dir / FACTOR_RETURNS_CSV, index=False)
+    return data_dir
+
+
+def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
+                 window: int = 20, decay: int = 10, pct: float = 0.2,
+                 max_weight: float = 0.5, qp_iters: int = 500,
+                 verbose: bool = True) -> dict:
+    """The full reference workflow; returns a dict of stage outputs."""
+    from factormodeling_tpu.compat.composite_factor import (
+        composite_factor_calculation,
+        weighted_composite_factor,
+    )
+    from factormodeling_tpu.compat.factor_selector import (
+        FactorSelector,
+        single_factor_metrics,
+    )
+    from factormodeling_tpu.compat.multi_manager import run_multimanager_backtest
+    from factormodeling_tpu.compat.operations import ts_decay
+    from factormodeling_tpu.compat.portfolio_analyzer import PortfolioAnalyzer
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation,
+        SimulationSettings,
+    )
+    from factormodeling_tpu.io import ArtifactStore
+
+    data_dir = Path(data_dir)
+    store = ArtifactStore(artifact_dir)
+    say = print if verbose else (lambda *a, **k: None)
+
+    # ---- 1. load (cells 4-5)
+    features_df = pd.read_csv(data_dir / FEATURES_CSV)
+    features_df["date"] = pd.to_datetime(features_df["date"])
+    features_df = features_df.set_index(["date", "symbol"])
+    factors_df = pd.read_csv(data_dir / FACTORS_CSV)
+    factors_df["date"] = pd.to_datetime(factors_df["date"])
+    factors_df = factors_df.set_index(["date", "symbol"])
+    single_factor_returns = pd.read_csv(data_dir / FACTOR_RETURNS_CSV)
+    single_factor_returns["date"] = pd.to_datetime(single_factor_returns["date"])
+    single_factor_returns = single_factor_returns.set_index("date")
+
+    returns = features_df["log_return"]
+    cap_flag = features_df["cap_flag"]
+    investability_flag = features_df["investability_flag"]
+    com_factors_df = pd.DataFrame(index=factors_df.index)
+    SimSettings = partial(
+        SimulationSettings, returns=returns, cap_flag=cap_flag,
+        investability_flag=investability_flag, factors_df=com_factors_df,
+        method="equal", transaction_cost=True, max_weight=max_weight,
+        pct=pct, plot=False, output_returns=True, qp_iters=qp_iters)
+
+    def simulate(name, feature, **overrides):
+        sim = Simulation(name, feature.rename("custom_feature"),
+                         SimSettings(**overrides))
+        result = sim.run()
+        summary = PortfolioAnalyzer(result).summary()
+        say(f"  {name}: " + ", ".join(
+            f"{k}={v}" for k, v in summary.items()
+            if k in ("Annualized Return", "Sharpe Ratio", "Maximum Drawdown")))
+        return result, summary
+
+    out: dict = {}
+
+    # ---- 2. full-sample metrics (cell 8)
+    say("=== Factor analysis metrics ===")
+    metrics = single_factor_metrics(factors_df, returns)
+    store.save_frame("10.factor_analysis_metrics", metrics)
+    say(metrics.round(4).to_string())
+    out["metrics"] = metrics
+
+    # ---- 3. static composites + decay + equal/linear sims (cells 10-18)
+    say("=== Static composites ===")
+    all_names = list(factors_df.columns)
+    results: dict = {}
+    for method in ("zscore", "rank"):
+        comp = composite_factor_calculation(factors_df, all_names, method=method)
+        com_factors_df[f"static_{method}"] = comp
+        decayed = ts_decay(comp, decay)
+        results[f"static_{method}_equal"] = simulate(
+            f"static_{method}_d{decay}_equal", decayed)
+        results[f"static_{method}_linear"] = simulate(
+            f"static_{method}_d{decay}_linear", decayed, method="linear",
+            max_weight=0.1)
+
+    # ---- 4. rolling selection (cells 21-23)
+    say("=== Rolling factor selection ===")
+    selector_specs = {
+        "icir": ("icir_top", {"top_x": 3, "icir_threshold": -1}),
+        "momentum": ("momentum", {"max_weight": 0.3}),
+        "mvo": ("mvo", {"max_weight": 0.3, "turnover_penalty": 0.5}),
+    }
+    factor_weights: dict = {}
+    for label, (method, kwargs) in selector_specs.items():
+        selector = FactorSelector(
+            factors_df=factors_df, returns=returns,
+            factor_ret_df=single_factor_returns, window=window,
+            method=method, method_kwargs=kwargs)
+        fw = selector.prepare_selection()
+        store.save_frame(f"factor_weights/factor_weights_{label}", fw)
+        say(f"  {label}: avg non-zero weights/day = "
+            f"{(fw > 0).sum(axis=1).mean():.2f}")
+        factor_weights[label] = fw
+    out["factor_weights"] = factor_weights
+
+    # ---- 5. weighted composites (cells 25-26)
+    say("=== Weighted composites ===")
+    composites: dict = {}
+    for label, fw in factor_weights.items():
+        comp = weighted_composite_factor(factors_df, fw, method="zscore")
+        store.save_frame(f"composite_factors/composite_factor_{label}_zscore",
+                         comp.to_frame("composite"))
+        com_factors_df[f"{label}_zscore"] = comp
+        composites[label] = comp
+    out["composites"] = composites
+
+    # ---- 6. per-composite sims across the 4 schemes (cells 30-49)
+    say("=== Simulations across weight schemes ===")
+    for label, comp in composites.items():
+        decayed = ts_decay(comp, decay)
+        for scheme, overrides in [
+            ("equal", {}),
+            ("linear", {"method": "linear", "max_weight": 0.1}),
+            ("mvo", {"method": "mvo"}),
+            ("mvo_turnover", {"method": "mvo_turnover",
+                              "turnover_penalty": 0.1}),
+        ]:
+            results[f"{label}_{scheme}"] = simulate(
+                f"{label}_d{decay}_{scheme}", decayed, **overrides)
+    out["results"] = results
+
+    # ---- 7. multi-manager (cells 53-56)
+    say("=== Multi-manager backtest ===")
+    mm_settings = SimSettings()
+    mm_result, top_longs, top_shorts, mm_counts = run_multimanager_backtest(
+        factors_df, returns, cap_flag, factor_weights["momentum"], mm_settings)
+    mm_summary = PortfolioAnalyzer(mm_result).summary()
+    store.save_frame("multimanager_result", mm_result.set_index("date"))
+    say("  multimanager: " + ", ".join(
+        f"{k}={v}" for k, v in mm_summary.items()
+        if k in ("Annualized Return", "Sharpe Ratio", "Maximum Drawdown")))
+    out["multimanager"] = (mm_result, mm_summary, mm_counts)
+
+    store.save_frame("com_factors_df", com_factors_df)  # cell 50
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--data", default=None,
+                        help="directory with the three reference CSVs "
+                             "(default: synthesize a demo set)")
+    parser.add_argument("--artifacts", default="data/artifacts")
+    parser.add_argument("--window", type=int, default=20)
+    parser.add_argument("--decay", type=int, default=10)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (skip the TPU relay)")
+    args = parser.parse_args()
+    _force_cpu_if_requested(args.cpu)
+
+    if args.data is None:
+        args.data = make_demo_data("data/demo")
+        print(f"synthesized demo data in {args.data}")
+    run_pipeline(args.data, args.artifacts, window=args.window,
+                 decay=args.decay)
+    print("pipeline complete; artifacts in", args.artifacts)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,7 @@ rolling aggregation, and a batched fixed-iteration ADMM QP solver.
 Layer map (mirrors SURVEY.md section 1, built TPU-first):
 
 - :mod:`factormodeling_tpu.panel`       L1 data model: dense masked panels
+- :mod:`factormodeling_tpu.io`          ingestion (3 reference CSV schemas) + artifact store
 - :mod:`factormodeling_tpu.ops`         L2 ops library (reference operations.py)
 - :mod:`factormodeling_tpu.metrics`     L3 factor scoring (factor_selector.py)
 - :mod:`factormodeling_tpu.selection`   L3 rolling selection + method registry
@@ -18,6 +19,7 @@ Layer map (mirrors SURVEY.md section 1, built TPU-first):
 - :mod:`factormodeling_tpu.backtest`    L4 simulation engine (portfolio_simulation.py)
 - :mod:`factormodeling_tpu.analytics`   L0 analytics (portfolio_analyzer.py)
 - :mod:`factormodeling_tpu.multimanager` L5 manager-of-managers (multi_manager.py)
+- :mod:`factormodeling_tpu.risk`        statistical risk model (factor cov + PCA)
 - :mod:`factormodeling_tpu.parallel`    mesh sharding / sweep harness
 - :mod:`factormodeling_tpu.compat`      pandas-facing API matching the reference
 """

@@ -1,0 +1,249 @@
+"""Data ingestion and the artifact store (L6 support).
+
+Reference: ``pipeline.ipynb`` cell 4 loads three CSV schemas and later cells
+persist every expensive stage back to ``data/`` (cells 8, 21-26, 50 —
+``factor_weights/*.csv``, ``composite_factors/*.csv``, ``com_factors_df.csv``),
+reloading them for downstream stages. This module gives the TPU framework the
+same two capabilities with a columnar format:
+
+- **Ingestion** — the three input schemas into dense panels:
+  1. ``2.symbol_features_long.csv``: long ``date,symbol`` rows carrying
+     ``log_return``, ``cap_flag``, ``investability_flag`` (cells 4-5) →
+     :class:`MarketData` (three aligned :class:`~factormodeling_tpu.panel.Panel`).
+  2. ``8.factors_df.csv``: long ``date,symbol`` rows + one column per factor →
+     :class:`~factormodeling_tpu.panel.FactorPanel`.
+  3. ``9.single_factor_returns.csv``: ``date`` rows + one column per factor →
+     :class:`FactorReturns` (dense ``[D, F]``).
+  CSV and parquet are auto-detected by extension.
+
+- **Artifact store** — :class:`ArtifactStore`: parquet persistence for the
+  stage outputs the reference writes to ``data/`` (factor-weight frames,
+  composite signal panels, result frames), plus content-addressed stage
+  caching (``cached``) so an unchanged stage reloads instead of recomputing —
+  the durable analog of ``FactorSelector``'s in-memory memoization
+  (``factor_selector.py:98-100``).
+
+Arrays flow host->device exactly once per load (one ``jnp.asarray`` on the
+densified block); everything label-shaped stays host-side in the Panel
+vocabularies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from pathlib import Path
+from typing import Callable, NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from factormodeling_tpu.panel import FactorPanel, Panel, _densify_long
+
+__all__ = [
+    "ArtifactStore",
+    "FactorReturns",
+    "MarketData",
+    "fingerprint",
+    "load_factor_returns",
+    "load_factors",
+    "load_symbol_features",
+    "read_table",
+    "write_table",
+]
+
+_FEATURE_COLUMNS = ("log_return", "cap_flag", "investability_flag")
+
+
+def read_table(path: str | Path, **kwargs) -> pd.DataFrame:
+    """Read a CSV or parquet table by extension (``.parquet``/``.pq`` ->
+    parquet, anything else -> CSV)."""
+    path = Path(path)
+    if path.suffix in (".parquet", ".pq"):
+        return pd.read_parquet(path, **kwargs)
+    return pd.read_csv(path, **kwargs)
+
+
+def write_table(df: pd.DataFrame, path: str | Path) -> Path:
+    """Write a table as parquet (``.parquet``/``.pq``) or CSV by extension,
+    creating parent directories."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    if path.suffix in (".parquet", ".pq"):
+        df.to_parquet(path)
+    else:
+        df.to_csv(path)
+    return path
+
+
+def _long_frame(df: pd.DataFrame, date_col: str, symbol_col: str) -> pd.DataFrame:
+    """Normalize a long table: datetime dates, (date, symbol) MultiIndex."""
+    if date_col in df.columns:
+        df = df.assign(**{date_col: pd.to_datetime(df[date_col])})
+        df = df.set_index([date_col, symbol_col])
+    elif not isinstance(df.index, pd.MultiIndex):
+        raise ValueError(
+            f"expected columns ({date_col!r}, {symbol_col!r}) or a "
+            f"(date, symbol) MultiIndex; got columns {list(df.columns)}")
+    return df
+
+
+@dataclasses.dataclass(frozen=True)
+class MarketData:
+    """The three market panels of ``2.symbol_features_long.csv`` on one grid
+    (``pipeline.ipynb`` cell 5 unpacks the same three columns)."""
+
+    returns: Panel
+    cap_flag: Panel
+    investability_flag: Panel
+
+    @property
+    def dates(self) -> np.ndarray:
+        return self.returns.dates
+
+    @property
+    def symbols(self) -> np.ndarray:
+        return self.returns.symbols
+
+
+class FactorReturns(NamedTuple):
+    """Dense per-date factor returns (``9.single_factor_returns.csv``)."""
+
+    values: jnp.ndarray       # float[D, F]
+    dates: np.ndarray
+    factor_names: tuple
+
+    def to_frame(self) -> pd.DataFrame:
+        return pd.DataFrame(np.asarray(self.values),
+                            index=pd.Index(self.dates, name="date"),
+                            columns=list(self.factor_names))
+
+
+def load_symbol_features(path: str | Path, *, date_col: str = "date",
+                         symbol_col: str = "symbol",
+                         dtype=jnp.float32) -> MarketData:
+    """Load the symbol-features schema into three aligned panels.
+
+    Expects long rows with at least ``log_return``, ``cap_flag``,
+    ``investability_flag`` columns (reference cell 4-5).
+    """
+    df = _long_frame(read_table(path), date_col, symbol_col)
+    missing = [c for c in _FEATURE_COLUMNS if c not in df.columns]
+    if missing:
+        raise ValueError(f"{path}: missing feature columns {missing}")
+    stacked, universe, dates, symbols = _densify_long(
+        df, _FEATURE_COLUMNS, dtype)
+    uni = jnp.asarray(universe)
+    block = jnp.asarray(stacked)
+    panels = [Panel(block[i], uni, dates, symbols)
+              for i in range(len(_FEATURE_COLUMNS))]
+    return MarketData(*panels)
+
+
+def load_factors(path: str | Path, *, date_col: str = "date",
+                 symbol_col: str = "symbol", exclude: Sequence[str] = (),
+                 dtype=jnp.float32) -> FactorPanel:
+    """Load the factor-exposure schema (``8.factors_df.csv``) into a
+    :class:`FactorPanel`; every non-index column is a factor unless excluded."""
+    df = _long_frame(read_table(path), date_col, symbol_col)
+    return FactorPanel.from_frame(df, exclude=exclude, dtype=dtype)
+
+
+def load_factor_returns(path: str | Path, *, date_col: str = "date",
+                        dtype=jnp.float32) -> FactorReturns:
+    """Load the per-date factor-return schema (``9.single_factor_returns.csv``)."""
+    df = read_table(path)
+    if date_col in df.columns:
+        df = df.assign(**{date_col: pd.to_datetime(df[date_col])})
+        df = df.set_index(date_col)
+    df = df.sort_index()
+    values = df.to_numpy(dtype=np.dtype(dtype), na_value=np.nan)
+    return FactorReturns(jnp.asarray(values), df.index.to_numpy(),
+                         tuple(df.columns))
+
+
+# --------------------------------------------------------------- artifacts
+
+
+def fingerprint(*parts) -> str:
+    """Content hash of arrays / scalars / strings — the cache key for
+    :meth:`ArtifactStore.cached`. Arrays hash their bytes (shape + dtype
+    included), so any input change invalidates the stage."""
+    h = hashlib.blake2b(digest_size=10)
+    for p in parts:
+        if isinstance(p, (Panel, FactorPanel)):
+            parts2 = (p.values, p.universe)
+        elif isinstance(p, FactorReturns):
+            parts2 = (p.values,) + p.factor_names
+        else:
+            parts2 = (p,)
+        for q in parts2:
+            if hasattr(q, "shape"):
+                arr = np.ascontiguousarray(np.asarray(q))
+                h.update(str(arr.shape).encode())
+                h.update(str(arr.dtype).encode())
+                h.update(arr.tobytes())
+            else:
+                h.update(repr(q).encode())
+        h.update(b"|")
+    return h.hexdigest()
+
+
+class ArtifactStore:
+    """Parquet-backed persistence for pipeline stage outputs.
+
+    Mirrors the reference's ``data/`` layout (``factor_weights/*``,
+    ``composite_factors/*``; cells 21-26) with three artifact shapes:
+
+    - frames: any date-indexed DataFrame (factor weights, result frames);
+    - panels: :class:`Panel` (composite signals) stored long;
+    - factor panels: :class:`FactorPanel` stored long, one column per factor.
+    """
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def path(self, name: str) -> Path:
+        return self.root / f"{name}.parquet"
+
+    def exists(self, name: str) -> bool:
+        return self.path(name).exists()
+
+    # ---- frames (factor weights, result frames, metric tables)
+
+    def save_frame(self, name: str, df: pd.DataFrame) -> Path:
+        return write_table(df, self.path(name))
+
+    def load_frame(self, name: str) -> pd.DataFrame:
+        return pd.read_parquet(self.path(name))
+
+    # ---- panels
+
+    def save_panel(self, name: str, panel: Panel) -> Path:
+        return write_table(panel.to_series(name="value").to_frame(),
+                           self.path(name))
+
+    def load_panel(self, name: str, dtype=jnp.float32) -> Panel:
+        return Panel.from_series(self.load_frame(name)["value"], dtype=dtype)
+
+    def save_factor_panel(self, name: str, fp: FactorPanel) -> Path:
+        return write_table(fp.to_frame(), self.path(name))
+
+    def load_factor_panel(self, name: str, dtype=jnp.float32) -> FactorPanel:
+        return FactorPanel.from_frame(self.load_frame(name), dtype=dtype)
+
+    # ---- stage caching
+
+    def cached(self, stage: str, key: str,
+               compute: Callable[[], pd.DataFrame]) -> pd.DataFrame:
+        """Content-addressed stage cache: reload ``<stage>-<key>`` if it was
+        persisted with the same input fingerprint, else compute and persist.
+        """
+        name = f"{stage}-{key}"
+        if self.exists(name):
+            return self.load_frame(name)
+        df = compute()
+        self.save_frame(name, df)
+        return df

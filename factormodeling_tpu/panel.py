@@ -14,6 +14,21 @@ that convention becomes a dense, fixed-shape array pair:
 Dates / symbols / factor names live host-side as numpy vocabularies; device
 arrays never carry labels. Ragged daily universes become fixed-N padded rows,
 and every kernel in :mod:`factormodeling_tpu.ops` is masking-aware.
+
+**This module is the single L1 front door.** Ways in:
+
+- ``Panel.from_series`` / ``FactorPanel.from_frame`` for pandas long frames
+  (and ``.to_series()`` / ``.to_frame()`` back out);
+- :mod:`factormodeling_tpu.io` loaders for the reference's CSV/parquet
+  schemas (they return these classes);
+- ``Panel.dense`` / ``FactorPanel.dense`` for raw arrays.
+
+The engine's kernels take the raw ``(values, universe)`` pair — ``Panel`` is
+the labeled carrier around exactly that pair, so ``panel.values,
+panel.universe`` feeds any kernel directly. The compat layer's ``PanelVocab``
+is an internal realignment detail (it reindexes results onto the *caller's*
+pandas index, which a standalone Panel does not track), not a second data
+model.
 """
 
 from __future__ import annotations
@@ -33,6 +48,37 @@ def _as_np_vocab(x) -> np.ndarray:
     if arr.ndim != 1:
         raise ValueError(f"vocabulary must be 1-D, got shape {arr.shape}")
     return arr
+
+
+def _index_level(index, name: str, position: int):
+    """A MultiIndex level by name, falling back to position when unnamed —
+    so a (symbol, date)-ordered index with named levels is NOT transposed."""
+    if name in (index.names or []):
+        return index.get_level_values(name)
+    return index.get_level_values(position)
+
+
+def _densify_long(df, columns, dtype):
+    """One pass over a (date, symbol)-indexed long frame -> stacked
+    ``[C, D, N]`` dense values + shared universe + vocabularies. The single
+    pandas->dense implementation behind ``Panel.from_series``,
+    ``FactorPanel.from_frame``, and the :mod:`factormodeling_tpu.io` loaders.
+    """
+    import pandas as pd
+
+    dates, date_idx = np.unique(
+        _index_level(df.index, "date", 0).to_numpy(), return_inverse=True)
+    symbols, sym_idx = np.unique(
+        _index_level(df.index, "symbol", 1).to_numpy(), return_inverse=True)
+    d, n = len(dates), len(symbols)
+    universe = np.zeros((d, n), dtype=bool)
+    universe[date_idx, sym_idx] = True
+    stacked = np.full((len(columns), d, n), np.nan, dtype=np.dtype(dtype))
+    for i, col in enumerate(columns):
+        vals = pd.to_numeric(df[col], errors="coerce").to_numpy(
+            dtype=np.dtype(dtype), na_value=np.nan)
+        stacked[i, date_idx, sym_idx] = vals
+    return stacked, universe, dates, symbols
 
 
 @jax.tree_util.register_dataclass
@@ -84,6 +130,27 @@ class Panel:
             universe = jnp.asarray(universe, dtype=bool)
         return Panel(values, universe, _as_np_vocab(dates), _as_np_vocab(symbols))
 
+    @staticmethod
+    def from_series(series, *, dtype=jnp.float32) -> "Panel":
+        """A (date, symbol)-MultiIndex pandas Series -> dense Panel (the
+        reference's implicit data model, SURVEY.md section 1). Levels are
+        resolved by name when named, by position otherwise."""
+        frame = series.to_frame("value")
+        stacked, universe, dates, symbols = _densify_long(
+            frame, ("value",), dtype)
+        return Panel(jnp.asarray(stacked[0]), jnp.asarray(universe),
+                     dates, symbols)
+
+    def to_series(self, name=None):
+        """Inverse of :meth:`from_series`: long Series over universe cells."""
+        import pandas as pd
+
+        di, si = np.nonzero(np.asarray(self.universe))
+        idx = pd.MultiIndex.from_arrays(
+            [np.asarray(self.dates)[di], np.asarray(self.symbols)[si]],
+            names=["date", "symbol"])
+        return pd.Series(np.asarray(self.values)[di, si], index=idx, name=name)
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -131,6 +198,29 @@ class FactorPanel:
         return FactorPanel(
             values, universe, _as_np_vocab(dates), _as_np_vocab(symbols), tuple(factor_names)
         )
+
+    @staticmethod
+    def from_frame(df, *, exclude=(), dtype=jnp.float32) -> "FactorPanel":
+        """A (date, symbol)-MultiIndex pandas DataFrame (one column per
+        factor) -> dense FactorPanel. Levels are resolved by name when
+        named, by position otherwise."""
+        names = tuple(c for c in df.columns if c not in exclude)
+        stacked, universe, dates, symbols = _densify_long(df, names, dtype)
+        return FactorPanel(jnp.asarray(stacked), jnp.asarray(universe),
+                           dates, symbols, names)
+
+    def to_frame(self):
+        """Inverse of :meth:`from_frame`: long DataFrame over universe cells."""
+        import pandas as pd
+
+        di, si = np.nonzero(np.asarray(self.universe))
+        values = np.asarray(self.values)
+        idx = pd.MultiIndex.from_arrays(
+            [np.asarray(self.dates)[di], np.asarray(self.symbols)[si]],
+            names=["date", "symbol"])
+        return pd.DataFrame({name: values[i, di, si]
+                             for i, name in enumerate(self.factor_names)},
+                            index=idx)
 
 
 def from_long(dates_idx, symbols_idx, values, *, n_dates=None, n_symbols=None,

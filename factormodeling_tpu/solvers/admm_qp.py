@@ -20,10 +20,11 @@ TPU design notes:
   1-D convex pieces). Equality constraints therefore hold to solver precision
   at every iterate — the property the reference warns about
   (``portfolio_simulation.py:448``).
-- The x-step linear system (P + rho I) is factored ONCE per problem: Cholesky
-  for dense P, Woodbury for P = alpha I + V' diag(s) V (a T-observation
-  return covariance gives T << N), so each iteration is O(nK + nT) matvecs —
-  never an O(n^3) solve, never an N x N matrix for the asset problems.
+- The x-step linear system (P + rho I) is factored once per rho value (a
+  handful of times per problem, see the adaptive-rho bullet): Cholesky for
+  dense P, Woodbury for P = alpha I + V' diag(s) V (a T-observation return
+  covariance gives T << N), so each iteration is O(nK + nT) matvecs — never
+  an O(n^3) solve, never an N x N matrix for the asset problems.
 - The objective is pre-scaled by mean(diag P) (argmin-invariant) so one rho
   scale works across the ~1e-6-variance problems this workload produces.
 - Adaptive rho by residual balancing (the OSQP scheme, sec. 5.2 of the OSQP

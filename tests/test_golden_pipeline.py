@@ -1,0 +1,121 @@
+"""Golden end-to-end pipeline test: the full reference workflow (metrics ->
+static + weighted composites -> rolling selection x3 -> 4-scheme sims ->
+multimanager) on a fixed synthetic panel, with pinned outputs.
+
+Pins were generated on the float64 CPU backend (the suite's configuration).
+Deterministic stages (metrics, equal/linear sims, icir/momentum selection)
+are pinned to 1e-8; QP-backed stages (mvo selection / mvo schemes) move with
+solver tuning, so they get loose bounds that still catch structural breaks.
+"""
+
+import importlib.util
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+_EXAMPLE = Path(__file__).resolve().parent.parent / "examples" / "pipeline.py"
+
+
+@pytest.fixture(scope="module")
+def pipeline_module():
+    spec = importlib.util.spec_from_file_location("example_pipeline", _EXAMPLE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def pipeline_out(pipeline_module, tmp_path_factory):
+    td = tmp_path_factory.mktemp("pipeline")
+    data = pipeline_module.make_demo_data(td / "data", n_dates=60,
+                                          n_symbols=24, seed=777)
+    return pipeline_module.run_pipeline(data, td / "artifacts", window=8,
+                                        decay=5, qp_iters=400, verbose=False)
+
+
+GOLDEN_IC = {
+    "mom_flx": 0.197587605, "val_flx": 0.1162441956, "mom_eq": 0.0556683783,
+    "val_long": -0.0078899352, "size_short": -0.0864634632,
+    "qual_flx": -0.1724716911,
+}
+
+# (sum of squared weights, count of positive weights)
+GOLDEN_FW = {"icir": (17.0, 153), "momentum": (22.4154644699, 159)}
+GOLDEN_FW_MVO_NONZERO = 246
+
+GOLDEN_LOGRET_EXACT = {
+    "static_zscore_equal": -0.0312778218,
+    "static_zscore_linear": -0.0135400884,
+    "static_rank_equal": -0.1690734487,
+    "static_rank_linear": -0.0183805223,
+    "icir_equal": 0.8099082096,
+    "icir_linear": 0.3447794585,
+    "momentum_equal": 0.8751389171,
+    "momentum_linear": 0.4096566664,
+}
+GOLDEN_LOGRET_QP = {
+    "icir_mvo": 0.2766937759,
+    "icir_mvo_turnover": 0.2466442934,
+    "momentum_mvo": 0.2853758305,
+    "momentum_mvo_turnover": 0.2668951946,
+    "mvo_equal": 0.7282800279,       # mvo-selected composite, equal scheme
+    "mvo_linear": 0.4119701453,
+    "mvo_mvo": 0.3337908019,
+    "mvo_mvo_turnover": 0.3509608524,
+}
+GOLDEN_MM_LOGRET = 0.5711278405
+
+
+def test_metrics_golden(pipeline_out):
+    m = pipeline_out["metrics"]
+    assert list(m.index) == list(GOLDEN_IC)  # sorted by rank_IC_IR desc
+    for fac, ic in GOLDEN_IC.items():
+        assert m.loc[fac, "IC"] == pytest.approx(ic, abs=1e-8)
+
+
+def test_factor_weights_golden(pipeline_out):
+    fw = pipeline_out["factor_weights"]
+    for label, (sq, nonzero) in GOLDEN_FW.items():
+        got = fw[label].to_numpy()
+        assert float((got ** 2).sum()) == pytest.approx(sq, abs=1e-8), label
+        assert int((got > 0).sum()) == nonzero, label
+        np.testing.assert_allclose(got.sum(axis=1),
+                                   np.ones(got.shape[0]), atol=1e-9)
+    mvo = fw["mvo"].to_numpy()
+    assert int((mvo > 0).sum()) == GOLDEN_FW_MVO_NONZERO
+    np.testing.assert_allclose(mvo.sum(axis=1), np.ones(mvo.shape[0]),
+                               atol=1e-9)
+    assert mvo.max() <= 0.3 / mvo.sum(axis=1).max() + 1e-6  # cap honored
+
+
+def test_simulation_results_golden(pipeline_out):
+    results = pipeline_out["results"]
+    for key, golden in GOLDEN_LOGRET_EXACT.items():
+        got = float(results[key][0]["log_return"].sum())
+        assert got == pytest.approx(golden, abs=1e-8), key
+    for key, golden in GOLDEN_LOGRET_QP.items():
+        got = float(results[key][0]["log_return"].sum())
+        assert got == pytest.approx(golden, abs=2e-2), key
+
+
+def test_multimanager_golden(pipeline_out):
+    mm_result, mm_summary, mm_counts = pipeline_out["multimanager"]
+    assert float(mm_result["log_return"].sum()) == pytest.approx(
+        GOLDEN_MM_LOGRET, abs=1e-8)
+    assert set(mm_counts.columns) >= {"long_count", "short_count"}
+
+
+def test_artifacts_persisted(pipeline_out, pipeline_module, tmp_path_factory):
+    # the store wrote every stage the reference persists (cells 8, 21-26, 50)
+    root = None
+    for p in tmp_path_factory.getbasetemp().glob("pipeline*/artifacts"):
+        root = p
+    assert root is not None
+    for name in ["10.factor_analysis_metrics",
+                 "factor_weights/factor_weights_icir",
+                 "factor_weights/factor_weights_momentum",
+                 "factor_weights/factor_weights_mvo",
+                 "composite_factors/composite_factor_icir_zscore",
+                 "multimanager_result", "com_factors_df"]:
+        assert (root / f"{name}.parquet").exists(), name

@@ -1,0 +1,172 @@
+"""Ingestion of the three reference CSV schemas + artifact-store round trips
+(reference ``pipeline.ipynb`` cells 4-5 load, cells 21-26 persist)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from factormodeling_tpu.io import (
+    ArtifactStore,
+    fingerprint,
+    load_factor_returns,
+    load_factors,
+    load_symbol_features,
+)
+from factormodeling_tpu.panel import FactorPanel, Panel
+
+D, N, F = 6, 5, 3
+
+
+@pytest.fixture
+def long_frames(rng):
+    """Ragged long frames in the reference's three schemas."""
+    dates = pd.date_range("2021-01-04", periods=D, freq="B")
+    symbols = [f"SYM{j}" for j in range(N)]
+    rows = []
+    for d in dates:
+        for j, s in enumerate(symbols):
+            if rng.uniform() < 0.15:  # ragged universe
+                continue
+            rows.append({
+                "date": d, "symbol": s,
+                "log_return": rng.normal(scale=0.02),
+                "cap_flag": float(rng.integers(1, 4)),
+                "investability_flag": 1.0,
+            })
+    features = pd.DataFrame(rows)
+    factors = features[["date", "symbol"]].copy()
+    for i in range(F):
+        factors[f"alpha{i}_flx"] = rng.normal(size=len(factors))
+    factors.loc[factors.index[::7], "alpha0_flx"] = np.nan  # NaN-valued cells
+    fr = pd.DataFrame({"date": dates,
+                       **{f"alpha{i}_flx": rng.normal(scale=0.005, size=D)
+                          for i in range(F)}})
+    return features, factors, fr
+
+
+def test_load_symbol_features_schema(tmp_path, long_frames):
+    features, _, _ = long_frames
+    path = tmp_path / "2.symbol_features_long.csv"
+    features.to_csv(path, index=False)
+    md = load_symbol_features(path)
+    assert md.returns.shape == (D, N)
+    assert md.returns.values.dtype == np.float32
+    # universe is shared across the three panels and matches the rows present
+    np.testing.assert_array_equal(np.asarray(md.returns.universe),
+                                  np.asarray(md.cap_flag.universe))
+    assert int(np.asarray(md.returns.universe).sum()) == len(features)
+    # spot-check one cell against the long frame
+    row = features.iloc[7]
+    di = list(md.dates).index(row["date"].to_datetime64())
+    si = list(md.symbols).index(row["symbol"])
+    assert np.asarray(md.returns.values)[di, si] == pytest.approx(
+        row["log_return"], rel=1e-6)
+
+
+def test_load_symbol_features_missing_column_raises(tmp_path, long_frames):
+    features, _, _ = long_frames
+    path = tmp_path / "bad.csv"
+    features.drop(columns=["cap_flag"]).to_csv(path, index=False)
+    with pytest.raises(ValueError, match="cap_flag"):
+        load_symbol_features(path)
+
+
+def test_load_factors_roundtrip(tmp_path, long_frames):
+    _, factors, _ = long_frames
+    path = tmp_path / "8.factors_df.csv"
+    factors.to_csv(path, index=False)
+    fp = load_factors(path)
+    assert fp.factor_names == tuple(f"alpha{i}_flx" for i in range(F))
+    assert fp.values.shape == (F, D, N)
+    # NaN-valued cells stay in the universe (value NaN, universe True)
+    vals = np.asarray(fp.values[0])
+    uni = np.asarray(fp.universe)
+    assert np.isnan(vals[uni]).any()
+    # to_frame/from_frame round trip preserves values on universe cells
+    fp2 = FactorPanel.from_frame(fp.to_frame())
+    np.testing.assert_allclose(np.asarray(fp2.values), np.asarray(fp.values),
+                               equal_nan=True)
+    np.testing.assert_array_equal(np.asarray(fp2.universe), uni)
+
+
+def test_load_factor_returns(tmp_path, long_frames):
+    _, _, fr = long_frames
+    path = tmp_path / "9.single_factor_returns.csv"
+    fr.to_csv(path, index=False)
+    loaded = load_factor_returns(path)
+    assert loaded.values.shape == (D, F)
+    pd.testing.assert_frame_equal(
+        loaded.to_frame(),
+        fr.assign(date=pd.to_datetime(fr["date"])).set_index("date"),
+        check_dtype=False, check_freq=False, atol=1e-6)
+
+
+def test_panel_series_roundtrip(long_frames):
+    features, _, _ = long_frames
+    series = features.set_index(["date", "symbol"])["log_return"]
+    p = Panel.from_series(series)
+    back = p.to_series(name="log_return")
+    pd.testing.assert_series_equal(back.sort_index(), series.sort_index(),
+                                   check_dtype=False, atol=1e-6)
+
+
+def test_panel_from_series_resolves_levels_by_name(long_frames):
+    """A (symbol, date)-ordered index with named levels must NOT transpose."""
+    features, _, _ = long_frames
+    series = features.set_index(["symbol", "date"])["log_return"]  # swapped
+    p = Panel.from_series(series)
+    reference = Panel.from_series(features.set_index(["date", "symbol"])
+                                  ["log_return"])
+    np.testing.assert_allclose(np.asarray(p.values),
+                               np.asarray(reference.values), equal_nan=True)
+    np.testing.assert_array_equal(p.dates, reference.dates)
+
+
+def test_artifact_store_frame_and_panel_roundtrip(tmp_path, long_frames, rng):
+    features, factors, _ = long_frames
+    store = ArtifactStore(tmp_path / "artifacts")
+
+    weights = pd.DataFrame(rng.uniform(size=(D, F)),
+                           index=pd.Index(pd.date_range("2021-01-04", periods=D,
+                                                        freq="B"), name="date"),
+                           columns=[f"alpha{i}_flx" for i in range(F)])
+    store.save_frame("factor_weights_icir", weights)
+    pd.testing.assert_frame_equal(store.load_frame("factor_weights_icir"),
+                                  weights, check_freq=False)
+
+    panel = Panel.from_series(features.set_index(["date", "symbol"])["log_return"])
+    store.save_panel("composite_zscore", panel)
+    p2 = store.load_panel("composite_zscore")
+    np.testing.assert_allclose(np.asarray(p2.values), np.asarray(panel.values),
+                               atol=1e-7, equal_nan=True)
+    np.testing.assert_array_equal(np.asarray(p2.universe),
+                                  np.asarray(panel.universe))
+
+    fp = FactorPanel.from_frame(factors.set_index(["date", "symbol"]))
+    store.save_factor_panel("factors", fp)
+    fp2 = store.load_factor_panel("factors")
+    assert fp2.factor_names == fp.factor_names
+    np.testing.assert_allclose(np.asarray(fp2.values), np.asarray(fp.values),
+                               atol=1e-7, equal_nan=True)
+
+
+def test_artifact_store_cached_stage(tmp_path, rng):
+    store = ArtifactStore(tmp_path / "artifacts")
+    x = rng.normal(size=(4, 3))
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return pd.DataFrame(x)
+
+    key = fingerprint(x, "stage-config")
+    a = store.cached("weights", key, compute)
+    b = store.cached("weights", key, compute)
+    assert len(calls) == 1  # second call reloaded from parquet
+    pd.testing.assert_frame_equal(a, b, check_names=False)
+
+    # changed input -> different key -> recompute
+    key2 = fingerprint(x + 1.0, "stage-config")
+    assert key2 != key
+    store.cached("weights", key2, compute)
+    assert len(calls) == 2
