@@ -27,7 +27,7 @@ from factormodeling_tpu.ops.group import (  # noqa: F401
     group_normalize,
     group_rank_normalized,
 )
-from factormodeling_tpu.ops.regression import cs_regression, ts_regression_fast  # noqa: F401
+from factormodeling_tpu.ops.regression import cs_ols, cs_regression, ts_regression_fast  # noqa: F401
 from factormodeling_tpu.ops.timeseries import (  # noqa: F401
     ts_backfill,
     ts_decay,

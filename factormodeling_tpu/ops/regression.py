@@ -17,7 +17,8 @@ import jax.numpy as jnp
 
 from factormodeling_tpu.ops._window import compaction_order, masked_shift, rolling_sum, shift
 
-__all__ = ["ts_regression_fast", "cs_regression", "TS_RETTYPES", "CS_RETTYPES"]
+__all__ = ["ts_regression_fast", "cs_regression", "cs_ols",
+           "TS_RETTYPES", "CS_RETTYPES"]
 
 _DATE_AXIS = -2
 _ASSET_AXIS = -1
@@ -126,3 +127,57 @@ def cs_regression(y: jnp.ndarray, x: jnp.ndarray, rettype: str = "resid",
         out = jnp.broadcast_to((cov_xy * cov_xy) / (var_x * var_y), y.shape)
     out = jnp.where(pair_valid, out, jnp.nan)
     return jnp.where(cnt >= 2, out, jnp.nan)
+
+
+def cs_ols(y: jnp.ndarray, x: jnp.ndarray, *,
+           universe: jnp.ndarray | None = None,
+           intercept: bool = True,
+           ridge: float = 0.0) -> jnp.ndarray:
+    """Barra-style per-date multivariate cross-sectional OLS.
+
+    Regresses each date's asset returns on that date's factor exposures,
+    producing the per-date factor-return vector — the multi-factor
+    generalization of :func:`cs_regression` (reference
+    ``operations.py:248-304`` is univariate) and of the no-intercept
+    univariate factor return in ``factor_selector.py:46-48``.
+
+    Args:
+      y: ``float[D, N]`` returns.
+      x: ``float[F, D, N]`` exposures (leading factor axis).
+      universe: optional ``bool[D, N]`` membership mask.
+      intercept: include a per-date intercept (estimated, not returned).
+      ridge: Levenberg-style diagonal regularization, scaled by the mean
+        diagonal of each date's normal matrix (0 disables).
+
+    Returns:
+      ``float[D, F]`` factor returns; dates with fewer valid assets than
+      regressors are NaN rows.
+
+    TPU design: one masked ``einsum`` builds all D normal systems
+    ``[D, F, F]`` on the MXU (O(D*N*F^2) flops), then one batched linear
+    solve of the regularized normal equations — no per-date host loop.
+    """
+    f = x.shape[0]
+    valid = ~jnp.isnan(y) & ~jnp.isnan(x).any(axis=0)
+    if universe is not None:
+        valid &= universe
+    m = valid.astype(y.dtype)                       # [D, N]
+    x0 = jnp.where(valid[None], x, 0.0)             # [F, D, N]
+    y0 = jnp.where(valid, y, 0.0)                   # [D, N]
+    cnt = m.sum(axis=-1)                            # [D]
+
+    if intercept:
+        # demean within the valid cross-section == estimating an intercept
+        cs = jnp.where(cnt > 0, cnt, 1.0)
+        x0 = x0 - (x0.sum(axis=-1, keepdims=True) / cs[None, :, None]) * m[None]
+        y0 = y0 - (y0.sum(axis=-1, keepdims=True) / cs[:, None]) * m
+
+    a = jnp.einsum("fdn,gdn->dfg", x0, x0)          # [D, F, F]
+    b = jnp.einsum("fdn,dn->df", x0, y0)            # [D, F]
+    tr = jnp.trace(a, axis1=-2, axis2=-1) / f
+    eps = jnp.asarray(ridge if ridge > 0 else 10 * jnp.finfo(y.dtype).eps,
+                      y.dtype)
+    a = a + (jnp.maximum(tr, 1.0) * eps)[:, None, None] * jnp.eye(f, dtype=y.dtype)
+    beta = jnp.linalg.solve(a, b[..., None])[..., 0]  # [D, F]
+    need = f + (1 if intercept else 0)
+    return jnp.where((cnt >= need)[:, None], beta, jnp.nan)

@@ -95,3 +95,44 @@ def test_ts_regression_fast(rng, rettype):
     exp = po.long_to_dense(
         po.o_ts_regression(po.dense_to_long(y), po.dense_to_long(x), w, rettype), D, N)
     np.testing.assert_allclose(got, exp, atol=1e-8, equal_nan=True)
+
+
+@pytest.mark.parametrize("intercept", [True, False])
+def test_cs_ols_matches_numpy_lstsq(rng, intercept):
+    """Barra-style multivariate per-date OLS vs a per-date numpy lstsq loop
+    (with NaN cells and a too-small date)."""
+    F = 3
+    x = rng.normal(size=(F, D, N))
+    beta_true = rng.normal(size=(D, F))
+    y = np.einsum("df,fdn->dn", beta_true, x) + rng.normal(scale=0.1, size=(D, N))
+    y[rng.uniform(size=(D, N)) < 0.1] = np.nan
+    x[0][rng.uniform(size=(D, N)) < 0.1] = np.nan
+    y[5, F + (1 if intercept else 0):] = np.nan  # too few assets -> NaN row
+
+    got = np.asarray(ops.cs_ols(jnp.array(y), jnp.array(x), intercept=intercept))
+
+    for d in range(D):
+        valid = ~np.isnan(y[d]) & ~np.isnan(x[:, d]).any(axis=0)
+        need = F + (1 if intercept else 0)
+        if valid.sum() < need:
+            assert np.isnan(got[d]).all(), d
+            continue
+        cols = [x[i, d, valid] for i in range(F)]
+        if intercept:
+            cols.append(np.ones(valid.sum()))
+        A = np.stack(cols, axis=1)
+        coef, *_ = np.linalg.lstsq(A, y[d, valid], rcond=None)
+        np.testing.assert_allclose(got[d], coef[:F], atol=1e-6, err_msg=str(d))
+
+
+def test_cs_ols_respects_universe(rng):
+    F = 2
+    x = rng.normal(size=(F, D, N))
+    y = rng.normal(size=(D, N))
+    universe = rng.uniform(size=(D, N)) > 0.2
+    got = np.asarray(ops.cs_ols(jnp.array(y), jnp.array(x),
+                                universe=jnp.array(universe)))
+    # equivalent to NaN-ing the non-universe cells
+    y2 = np.where(universe, y, np.nan)
+    exp = np.asarray(ops.cs_ols(jnp.array(y2), jnp.array(x)))
+    np.testing.assert_allclose(got, exp, atol=1e-12, equal_nan=True)
