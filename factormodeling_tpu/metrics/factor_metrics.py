@@ -23,7 +23,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax.scipy.special import betainc
 
-from factormodeling_tpu.ops._rank import avg_rank
+from factormodeling_tpu.ops._rank import rank_sorted
 from factormodeling_tpu.ops._window import masked_shift, rolling_sum, shift
 
 METRIC_COLUMNS = (
@@ -94,8 +94,13 @@ def daily_factor_stats(factors: jnp.ndarray, returns: jnp.ndarray,
     enough = cnt >= min_pairs
 
     ic = _masked_pearson(f, r, valid)
-    franks = avg_rank(f, axis=_ASSET_AXIS)
-    rank_ic = _masked_pearson(franks, r, valid)
+    # rank-IC in sorted space: Pearson is permutation-invariant, so carry r
+    # through the rank sort as a payload operand — no second sort to
+    # un-permute the ranks, no gather (both lower poorly on TPU; the one
+    # sort dominates this whole function's cost)
+    franks_sorted, valid_sorted, (r_sorted,) = rank_sorted(
+        f, axis=_ASSET_AXIS, carry=(r,))
+    rank_ic = _masked_pearson(franks_sorted, r_sorted, valid_sorted)
 
     f0 = jnp.where(valid, f, 0.0)
     r0 = jnp.where(valid, r, 0.0)

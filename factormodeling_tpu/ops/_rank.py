@@ -2,12 +2,13 @@
 
 pandas cross-sectional semantics the reference relies on (``operations.py``):
 average-tie ranks over the non-NaN subset, linear-interpolation quantiles, and
-group-scoped variants. The TPU formulation is sort-based: one multi-key
-``lax.sort`` per kernel (validity flag first, so NaN padding can never collide
-with genuine values), tie runs resolved with cummax/cummin over run-start
-indicators, results scattered back through the inverse permutation. Everything
-batches over leading dims without vmap because ``lax.sort`` sorts one chosen
-dimension elementwise.
+group-scoped variants. The TPU formulation is sort-based with as few sorts and
+no gathers/scatters (both lower poorly on TPU): values are the single sort key
+(NaNs canonicalized so XLA's total order sends them last), tie/segment runs are
+resolved with cummax/cummin over run-start indicators, co-arrays ride along as
+sort payloads, and order-dependent results pay one extra single-key sort to
+invert the permutation instead of a scatter. Everything batches over leading
+dims without vmap because ``lax.sort`` sorts one chosen dimension elementwise.
 """
 
 from __future__ import annotations
@@ -15,7 +16,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["avg_rank", "masked_quantile", "segment_avg_rank"]
+__all__ = ["avg_rank", "masked_quantile", "rank_sorted", "segment_avg_rank"]
 
 
 def _run_starts_to_last(is_start: jnp.ndarray, axis: int) -> jnp.ndarray:
@@ -54,6 +55,12 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
     rule for NaN rows too, ``operations.py:158-160``).
 
     With ``seg_ids == 0`` everywhere this is a full cross-sectional rank.
+
+    TPU shape: two sorts total — one 2-key sort ``(segment, value)`` with an
+    iota payload, then one 1-key inversion sort carrying ranks and counts
+    back to the original order. Run aggregates (segment valid-counts) are
+    broadcast to members with cummax/cummin index tricks, never gathers —
+    TPU lowers arbitrary gathers/scatters poorly.
     """
     axis = axis % values.ndim
     n = values.shape[axis]
@@ -62,12 +69,15 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
     ar = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32).reshape(shape), values.shape)
 
     seg_ids = jnp.broadcast_to(seg_ids, values.shape).astype(jnp.int32)
-    valid = ~jnp.isnan(values) & (seg_ids >= 0)
-    invalid_key = (~valid).astype(jnp.int32)
-    vals_key = jnp.where(valid, values, 0.0)
+    in_seg = seg_ids >= 0
+    valid = ~jnp.isnan(values) & in_seg
+    seg_key = jnp.where(in_seg, seg_ids, jnp.iinfo(jnp.int32).max)
+    # canonicalized NaNs sort after every real value within their segment
+    val_key = jnp.where(valid, values, jnp.nan)
 
-    s_invalid, s_seg, s_val, s_idx = lax.sort(
-        (invalid_key, seg_ids, vals_key, ar), dimension=axis, num_keys=3, is_stable=True)
+    s_seg, s_val, s_idx = lax.sort((seg_key, val_key, ar), dimension=axis,
+                                   num_keys=2, is_stable=False)
+    valid_sorted = ~jnp.isnan(s_val)
 
     def shift_one(a):
         return jnp.concatenate(
@@ -77,48 +87,94 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
         [jnp.ones_like(lax.slice_in_dim(s_seg, 0, 1, axis=axis), dtype=bool),
          jnp.zeros_like(lax.slice_in_dim(s_seg, 0, n - 1, axis=axis), dtype=bool)],
         axis=axis)
-    seg_start = first_col | (s_seg != shift_one(s_seg)) | (s_invalid != shift_one(s_invalid))
-    tie_start = seg_start | (s_val != shift_one(s_val))
+    seg_start = first_col | (s_seg != shift_one(s_seg))
+    tie_start = seg_start | (s_val != shift_one(s_val))  # NaN != NaN -> own run
 
-    pos = jnp.broadcast_to(jnp.arange(n).reshape(shape), values.shape)
     seg_first = _run_starts_to_first(seg_start, axis)
-    seg_last = _run_starts_to_last(seg_start, axis)
     tie_first = _run_starts_to_first(tie_start, axis)
     tie_last = _run_starts_to_last(tie_start, axis)
 
+    # within a segment run the valid cells come first, so rank = offset + 1
     avg_rank_sorted = 0.5 * ((tie_first - seg_first + 1) + (tie_last - seg_first + 1))
-    count_sorted = (seg_last - seg_first + 1).astype(values.dtype)
-    rank_ok = s_invalid == 0
-    avg_rank_sorted = jnp.where(rank_ok, avg_rank_sorted, jnp.nan)
+    avg_rank_sorted = jnp.where(valid_sorted, avg_rank_sorted, jnp.nan)
 
-    inv = jnp.argsort(s_idx, axis=axis)
-    ranks = jnp.take_along_axis(avg_rank_sorted, inv, axis=axis)
+    # per-segment valid count broadcast to every member (NaN members too):
+    # csum at the segment's last position minus csum just before its first,
+    # both propagated along the run by cummax/cummin — no gathers.
+    csum = jnp.cumsum(valid_sorted.astype(jnp.int32), axis=axis)
+    base_at_start = jnp.where(seg_start, csum - valid_sorted.astype(jnp.int32), -1)
+    base = lax.cummax(base_at_start, axis=axis)
+    nxt_start = jnp.concatenate(
+        [lax.slice_in_dim(seg_start, 1, n, axis=axis),
+         jnp.ones_like(lax.slice_in_dim(seg_start, 0, 1, axis=axis))], axis=axis)
+    total_at_last = jnp.where(nxt_start, csum, jnp.iinfo(jnp.int32).max)
+    total = jnp.flip(lax.cummin(jnp.flip(total_at_last, axis=axis), axis=axis),
+                     axis=axis)
+    count_sorted = (total - base).astype(values.dtype)
 
-    # valid count per segment id, gathered for every cell carrying that id
-    # (including NaN cells) via a second pass keyed on seg alone.
-    seg_for_count = jnp.where(seg_ids >= 0, seg_ids, jnp.iinfo(jnp.int32).max)
-    c_seg, c_valid, c_idx = lax.sort(
-        (seg_for_count, valid.astype(jnp.int32), ar), dimension=axis, num_keys=1,
-        is_stable=True)
-    cstart = first_col | (c_seg != shift_one(c_seg))
-    cfirst = _run_starts_to_first(cstart, axis)
-    csum = jnp.cumsum(c_valid, axis=axis)
-    base = jnp.take_along_axis(csum, cfirst, axis=axis) - jnp.take_along_axis(
-        c_valid, cfirst, axis=axis)
-    clast = _run_starts_to_last(cstart, axis)
-    total = jnp.take_along_axis(csum, clast, axis=axis) - base
-    cinv = jnp.argsort(c_idx, axis=axis)
-    counts = jnp.take_along_axis(total, cinv, axis=axis)
-    counts = jnp.where(seg_ids >= 0, counts, 0)
-
+    _, ranks, counts = lax.sort((s_idx, avg_rank_sorted, count_sorted),
+                                dimension=axis, num_keys=1, is_stable=False)
+    counts = jnp.where(in_seg, counts, 0)
     return ranks, counts
+
+
+def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=()):
+    """Average-tie 1-based ranks **in sorted order**, from ONE single-key sort.
+
+    Returns ``(ranks_sorted, valid_sorted, carried)`` where ``ranks_sorted[i]``
+    is the rank of the i-th smallest value, ``valid_sorted`` marks non-NaN
+    cells (NaNs canonicalized so XLA's total order sends them last), and
+    ``carried`` holds each array of ``carry`` (broadcastable to
+    ``values.shape``) co-sorted into the same order.
+
+    Rationale: the TPU cost of ranking is the sort, and both arbitrary
+    gathers and scatters lower poorly. Order-independent consumers
+    (rank-IC's Pearson, whole-axis reductions) should stay in sorted space,
+    shipping their co-arrays through the sort as extra payload operands —
+    see ``metrics/factor_metrics.py``. Order-dependent consumers carry an
+    iota and pay a second sort to invert (:func:`avg_rank`).
+    """
+    axis = axis % values.ndim
+    n = values.shape[axis]
+    # canonicalize NaN sign: XLA total order sorts -NaN first but +NaN last
+    key = jnp.where(jnp.isnan(values), jnp.nan, values)
+    operands = (key,) + tuple(jnp.broadcast_to(c, values.shape) for c in carry)
+    s_key, *s_carry = lax.sort(operands, dimension=axis, num_keys=1,
+                               is_stable=True)
+    valid_sorted = ~jnp.isnan(s_key)
+
+    def shift_one(a):
+        return jnp.concatenate(
+            [lax.slice_in_dim(a, 0, 1, axis=axis),
+             lax.slice_in_dim(a, 0, n - 1, axis=axis)], axis=axis)
+
+    first_col = jnp.concatenate(
+        [jnp.ones_like(lax.slice_in_dim(valid_sorted, 0, 1, axis=axis)),
+         jnp.zeros_like(lax.slice_in_dim(valid_sorted, 0, n - 1, axis=axis))],
+        axis=axis)
+    tie_start = first_col | (s_key != shift_one(s_key))  # NaN != NaN -> own run
+    tie_first = _run_starts_to_first(tie_start, axis)
+    tie_last = _run_starts_to_last(tie_start, axis)
+    ranks_sorted = 0.5 * (tie_first + tie_last).astype(values.dtype) + 1.0
+    ranks_sorted = jnp.where(valid_sorted, ranks_sorted, jnp.nan)
+    return ranks_sorted, valid_sorted, tuple(s_carry)
 
 
 def avg_rank(values: jnp.ndarray, *, axis: int = -1) -> jnp.ndarray:
     """Average-tie 1-based rank among non-NaN values along ``axis`` (NaN -> NaN),
-    i.e. ``scipy.stats.rankdata`` / pandas ``rank(method='average')``."""
-    zeros = jnp.zeros(values.shape, dtype=jnp.int32)
-    ranks, _ = segment_avg_rank(values, zeros, axis=axis)
+    i.e. ``scipy.stats.rankdata`` / pandas ``rank(method='average')``.
+
+    Two single-key sorts (rank, then permutation inversion) — TPU lowers a
+    one-key sort ~10x faster than the multi-key variadic form, and sort-based
+    inversion beats a scatter, which TPU serializes."""
+    axis = axis % values.ndim
+    n = values.shape[axis]
+    shape = [1] * values.ndim
+    shape[axis] = n
+    ar = jnp.arange(n, dtype=jnp.int32).reshape(shape)
+    ranks_sorted, _, (s_idx,) = rank_sorted(values, axis=axis, carry=(ar,))
+    _, ranks = lax.sort((s_idx, ranks_sorted), dimension=axis, num_keys=1,
+                        is_stable=False)
     return ranks
 
 
