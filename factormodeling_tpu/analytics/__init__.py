@@ -2,6 +2,12 @@
 plus the plotting/quantile helpers of ``composite_factor.py``."""
 
 from factormodeling_tpu.analytics.analyzer import PortfolioAnalyzer  # noqa: F401
+from factormodeling_tpu.analytics.decay import (  # noqa: F401
+    DecaySensitivity,
+    batched_ts_decay,
+    decay_sensitivity,
+    plot_decay_sensitivity,
+)
 from factormodeling_tpu.analytics.plots import (  # noqa: F401
     plot_factor_distributions,
     plot_full_performance,

@@ -110,3 +110,55 @@ def test_plots_render_headless(rng, tmp_path):
                                    np.arange(40), 5)
     fig3.savefig(tmp_path / "quant.png")
     assert (tmp_path / "dash.png").stat().st_size > 10000
+
+
+def test_batched_ts_decay_matches_serial(rng):
+    from factormodeling_tpu import ops
+    from factormodeling_tpu.analytics import batched_ts_decay
+
+    x = rng.normal(size=(30, 8))
+    x[rng.uniform(size=x.shape) < 0.1] = np.nan
+    universe = rng.uniform(size=x.shape) > 0.15
+    xd = jnp.array(x)
+    got = np.asarray(batched_ts_decay(xd, (1, 4, 7), jnp.array(universe)))
+    for i, w in enumerate((1, 4, 7)):
+        exp = np.asarray(ops.ts_decay(xd, w, universe=jnp.array(universe)))
+        np.testing.assert_allclose(got[i], exp, atol=1e-12, equal_nan=True)
+
+
+def test_decay_sensitivity_matches_per_window_loop(rng, tmp_path):
+    """The one-vmap sweep must equal K serial (ts_decay -> run_simulation)
+    passes with the reference helper's metric formulas (pipeline.ipynb
+    cell 6: annret = prod(1+r)**(252/D)-1, sharpe = mean/std(ddof=1)*sqrt252)."""
+    from factormodeling_tpu import ops
+    from factormodeling_tpu.analytics import decay_sensitivity
+    from factormodeling_tpu.analytics.decay import plot_decay_sensitivity
+    from factormodeling_tpu.backtest import run_simulation
+
+    d, n = 60, 16
+    returns = rng.normal(scale=0.02, size=(d, n))
+    signal = rng.normal(size=(d, n))
+    signal[rng.uniform(size=(d, n)) < 0.1] = np.nan
+    s = SimulationSettings(
+        returns=jnp.array(returns),
+        cap_flag=jnp.array(rng.integers(1, 4, size=(d, n)).astype(float)),
+        investability_flag=jnp.ones((d, n)), method="linear", max_weight=0.3)
+
+    periods = (1, 5, 10)
+    sens = decay_sensitivity(jnp.array(signal), s, periods)
+
+    for i, w in enumerate(periods):
+        sig_w = ops.ts_decay(jnp.array(signal), w)
+        r = np.asarray(run_simulation(sig_w, s).result.log_return)
+        ann = np.prod(1.0 + r) ** (252.0 / d) - 1.0
+        sharpe = r.mean() / r.std(ddof=1) * np.sqrt(252.0)
+        np.testing.assert_allclose(float(sens.annualized_return[i]), ann,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(sens.sharpe[i]), sharpe, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(sens.log_return[i]), r,
+                                   atol=1e-7)
+
+    fig, sens2 = plot_decay_sensitivity(jnp.array(signal), s, periods,
+                                        show=False)
+    fig.savefig(tmp_path / "decay.png")
+    assert (tmp_path / "decay.png").stat().st_size > 5000
