@@ -142,9 +142,17 @@ def _rolling_mean(x, w):
 
 
 def _rolling_std(x, w):
+    """Trailing-window std (ddof=1) via cumulative sums; centering first
+    keeps the sum-of-squares difference numerically stable."""
+    x = np.asarray(x, dtype=np.float64)
+    xc = x - x.mean()
+    c1 = np.cumsum(np.concatenate([[0.0], xc]))
+    c2 = np.cumsum(np.concatenate([[0.0], xc * xc]))
+    s = c1[w:] - c1[:-w]
+    s2 = c2[w:] - c2[:-w]
+    var = np.maximum(s2 - s * s / w, 0.0) / (w - 1)
     out = np.full(len(x), np.nan)
-    for i in range(w - 1, len(x)):
-        out[i] = np.std(x[i - w + 1:i + 1], ddof=1)
+    out[w - 1:] = np.sqrt(var)
     return out
 
 
