@@ -175,6 +175,15 @@ def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
             f"static_{method}_d{decay}_linear", decayed, method="linear",
             max_weight=0.1)
 
+    # ---- 3b. decay-window sensitivity (cells 6/14/18)
+    say("=== Decay sensitivity (static_zscore) ===")
+    from factormodeling_tpu.compat.decay import decay_sensitivity
+
+    sens = decay_sensitivity(com_factors_df["static_zscore"], SimSettings(),
+                             decay_period=[1, 5, decay, 2 * decay])
+    say(sens.round(4).to_string())
+    out["decay_sensitivity"] = sens
+
     # ---- 4. rolling selection (cells 21-23)
     say("=== Rolling factor selection ===")
     selector_specs = {

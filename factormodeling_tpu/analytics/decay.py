@@ -82,7 +82,19 @@ def _sweep(stack: jnp.ndarray, settings: SimulationSettings):
     out = jax.vmap(lambda sig: run_simulation(sig, settings))(stack)
     r = out.result.log_return                                    # [K, D]
     d = r.shape[1]
-    ann = jnp.exp(jnp.log1p(r).sum(axis=1) * (252.0 / d)) - 1.0
+    # prod(1+r)**(252/d) - 1 in sign-tracked log-magnitude space: identical
+    # to the reference's numpy expression (including prod<=0 edge cases:
+    # zero -> -1, negative -> NaN from the fractional power) but without
+    # f32 over/underflow at long horizons
+    one_r = 1.0 + r
+    logmag = jnp.log(jnp.abs(one_r))           # log(0) -> -inf, prod -> 0
+    neg_prod = ((one_r < 0.0).sum(axis=1) % 2 == 1) & ~(one_r == 0.0).any(axis=1)
+    e = 252.0 / d                              # static under jit
+    mag = jnp.exp(logmag.sum(axis=1) * e)
+    if e == int(e):                            # negative**integer is real
+        ann = jnp.where(neg_prod, mag * (-1.0 if int(e) % 2 else 1.0), mag) - 1.0
+    else:                                      # negative**fractional -> NaN
+        ann = jnp.where(neg_prod, jnp.nan, mag - 1.0)
     sharpe = r.mean(axis=1) / r.std(axis=1, ddof=1) * jnp.sqrt(252.0)
     return ann, sharpe, r
 

@@ -1,0 +1,71 @@
+"""Reference ``plot_decay_sensitivity`` (pipeline.ipynb cell 6) on the
+pandas surface.
+
+The notebook helper loops decay windows, re-running a full ``Simulation``
+per window. Here the loop collapses into the native batched sweep
+(:mod:`factormodeling_tpu.analytics.decay`): all K decayed signals are built
+under one jit and simulated by one ``vmap`` — identical metric formulas
+(``annret = prod(1+r)**(252/N) - 1``, ``sharpe = mean/std(ddof=1)*sqrt(252)``)
+and the same twin-axis plot.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+
+from factormodeling_tpu.analytics.decay import (
+    DEFAULT_DECAY_PERIODS,
+    decay_sensitivity as _dense_decay_sensitivity,
+    plot_decay_sensitivity as _dense_plot,
+)
+from factormodeling_tpu.compat.portfolio_simulation import (
+    Simulation,
+    SimulationSettings,
+)
+
+__all__ = ["decay_sensitivity", "plot_decay_sensitivity"]
+
+
+def decay_sensitivity(
+    composite_factor: pd.Series,
+    settings: SimulationSettings,
+    decay_period: list[int] = list(DEFAULT_DECAY_PERIODS),
+) -> pd.DataFrame:
+    """Annualized return and Sharpe per decay window as a DataFrame indexed
+    by window length (the numbers the reference helper plots)."""
+    sim = Simulation("decay_sensitivity", composite_factor, settings)
+    sig, uni = sim._signal_dense()
+    dense = sim._dense_settings(uni)
+    sens = _dense_decay_sensitivity(jnp.asarray(sig), dense,
+                                    tuple(decay_period),
+                                    universe=jnp.asarray(uni))
+    return pd.DataFrame(
+        {"annualized_return": np.asarray(sens.annualized_return),
+         "sharpe_ratio": np.asarray(sens.sharpe)},
+        index=pd.Index(list(decay_period), name="decay_window"))
+
+
+def plot_decay_sensitivity(
+    composite_factor: pd.Series,
+    settings: SimulationSettings,
+    decay_period: list[int] = list(DEFAULT_DECAY_PERIODS),
+    figsize: tuple[int, int] = (12, 6),
+):
+    """Reference signature and side effects (``pipeline.ipynb`` cell 6):
+    forces ``output_returns=True`` / ``plot=False`` on the settings, sweeps
+    the decay grid, draws the twin-axis annualized-return / Sharpe figure.
+
+    Deliberate deviation: the reference loop's ``Simulation.run`` registers
+    every decayed feature into the shared ``factors_df`` (columns
+    ``decay_1``, ``decay_3``, ...) as a side effect of ``:72``; this sweep
+    leaves ``factors_df`` untouched."""
+    settings.output_returns = True
+    settings.plot = False
+    sim = Simulation("decay_sensitivity", composite_factor, settings)
+    sig, uni = sim._signal_dense()
+    dense = sim._dense_settings(uni)
+    fig, _ = _dense_plot(jnp.asarray(sig), dense, tuple(decay_period),
+                         universe=jnp.asarray(uni), figsize=figsize)
+    return fig

@@ -383,3 +383,47 @@ def test_result_spans_union_of_weight_and_return_dates(rng):
         assert np.array_equal(np.isnan(got.to_numpy()), np.isnan(exp.to_numpy()))
         np.testing.assert_allclose(got.dropna().to_numpy(),
                                    exp.dropna().to_numpy(), atol=1e-9)
+
+
+def test_compat_decay_sensitivity_matches_per_window_loop(rng, tmp_path):
+    """The compat sweep must equal the reference helper's per-window loop
+    (pipeline.ipynb cell 6): ts_decay per window -> Simulation.run ->
+    annret = prod(1+r)**(252/N)-1, sharpe = mean/std(ddof=1)*sqrt(252)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    from factormodeling_tpu.compat import operations as cop
+    from factormodeling_tpu.compat.decay import (
+        decay_sensitivity, plot_decay_sensitivity)
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation, SimulationSettings)
+
+    returns, cap, invest = market_data(rng)
+    signal = make_panel(rng).reindex(returns.index)
+    periods = [1, 3, 6]
+
+    def settings():
+        return SimulationSettings(
+            returns=returns, cap_flag=cap, investability_flag=invest,
+            factors_df=None, method="equal", pct=0.3, plot=False,
+            output_returns=True)
+
+    got = decay_sensitivity(signal, settings(), periods)
+    assert list(got.index) == periods
+
+    for w in periods:
+        feat = cop.ts_decay(signal, w).rename("custom_feature")
+        result = Simulation(f"decay_{w}", feat, settings()).run()
+        daily_r = result.sort_values("date")["log_return"].to_numpy()
+        annret = np.prod(1 + daily_r) ** (252 / len(daily_r)) - 1
+        sharpe = (daily_r.mean() / daily_r.std(ddof=1)) * np.sqrt(252)
+        np.testing.assert_allclose(got.loc[w, "annualized_return"], annret,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(got.loc[w, "sharpe_ratio"], sharpe,
+                                   rtol=1e-5)
+
+    s = settings()
+    s.plot = True
+    fig = plot_decay_sensitivity(signal, s, periods)
+    assert s.output_returns and not s.plot  # reference side effects
+    fig.savefig(tmp_path / "compat_decay.png")
+    assert (tmp_path / "compat_decay.png").stat().st_size > 5000
