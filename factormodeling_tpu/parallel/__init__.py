@@ -16,6 +16,11 @@ and ``jit`` / ``shard_map`` let XLA lower the cross-shard reductions
 (``psum``/halo exchanges for rolling windows) onto ICI.
 """
 
+from factormodeling_tpu.parallel.cluster import (  # noqa: F401
+    initialize_cluster,
+    make_hybrid_mesh,
+    num_slices,
+)
 from factormodeling_tpu.parallel.mesh import (  # noqa: F401
     balanced_mesh_shape,
     make_mesh,

@@ -1,0 +1,117 @@
+"""Multi-host cluster bring-up and topology-aware meshes.
+
+The reference is single-process pandas with no communication backend
+(SURVEY.md section 2.8: ``multiprocessing`` imported, never used); the
+TPU-native equivalent of "scale past one box" is multi-controller JAX:
+one process per host, ``jax.distributed.initialize`` for the coordination
+service, a global ``Mesh`` over all chips, and the same ``jit`` + sharding
+annotations as single-host — XLA routes collectives over ICI within a slice
+and DCN between slices.
+
+Axis placement rule (the scaling-book recipe): put the axis with the
+heaviest cross-shard traffic on ICI, the near-embarrassingly-parallel axis
+on DCN. For this workload the **date** axis does halo exchanges (rolling
+windows, 1-day shifts) and the **factor/combo** axis is contraction-only
+(one ``psum`` when selection collapses it), so factors/combos go on the
+DCN axis and dates stay inside the slice:
+
+    mesh = make_hybrid_mesh(("factor", "date"))   # factor = DCN, date = ICI
+
+Single-slice (or CPU-test) environments fall back to a plain balanced mesh,
+so the same code runs everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from factormodeling_tpu.parallel.mesh import balanced_mesh_shape
+
+__all__ = ["initialize_cluster", "num_slices", "make_hybrid_mesh"]
+
+
+def initialize_cluster(coordinator_address: str | None = None,
+                       num_processes: int | None = None,
+                       process_id: int | None = None) -> None:
+    """Bring up multi-controller JAX (one call per host process, before any
+    backend use). With no arguments, defers to the environment: on managed
+    TPU pods ``jax.distributed.initialize()`` auto-discovers the coordinator
+    and process ranks; standalone clusters pass them explicitly (the
+    NCCL/MPI-rendezvous analog). No-op when already initialized or when the
+    process is single-host with no coordination env. Must run before any
+    other JAX call touches the backend (``jax.devices()`` etc.)."""
+    if jax.distributed.is_initialized():
+        return
+    if (coordinator_address is not None or num_processes is not None
+            or process_id is not None):
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return
+    try:
+        # the canonical pod bring-up: JAX's cluster detectors (GCE/GKE TPU,
+        # SLURM, k8s, MPI) fill coordinator and ranks when one is present
+        jax.distributed.initialize()
+    except ValueError as e:
+        if "coordinator_address" in str(e):
+            return  # no cluster environment detected -> single process
+        raise  # a cluster WAS detected but bring-up failed: surface it
+    except RuntimeError as e:
+        if "before any JAX calls" in str(e):
+            return  # backend already up in a single-process session
+        raise
+
+
+def num_slices(devices=None) -> int:
+    """Number of ICI-connected slices among ``devices`` (1 on CPU/single
+    slice). Distinct ``slice_index`` attributes mark DCN boundaries."""
+    if devices is None:
+        devices = jax.devices()
+    indices = {getattr(d, "slice_index", 0) for d in devices}
+    return len(indices)
+
+
+def make_hybrid_mesh(axis_names: tuple[str, ...] = ("factor", "date"),
+                     dcn_axis: str | None = None,
+                     devices=None) -> Mesh:
+    """A topology-aware mesh: ``dcn_axis`` (default: the first axis name)
+    spans slices over DCN, every other axis stays inside a slice on ICI.
+
+    Single-slice or CPU environments get a balanced mesh over the available
+    devices with the same axis names, so tests and laptops run the exact
+    mesh-consuming code that pods do.
+    """
+    if devices is None:
+        devices = jax.devices()
+    dcn_axis = dcn_axis or axis_names[0]
+    if dcn_axis not in axis_names:
+        raise ValueError(f"dcn_axis {dcn_axis!r} not in {axis_names}")
+    slices = num_slices(devices)
+    if slices <= 1:
+        shape = balanced_mesh_shape(len(devices), len(axis_names))
+        grid = mesh_utils.create_device_mesh(shape, devices=devices,
+                                             allow_split_physical_axes=True)
+        return Mesh(grid, axis_names)
+    per_slice = len(devices) // slices
+    others = [n for n in axis_names if n != dcn_axis]
+    ici_shape = balanced_mesh_shape(per_slice, len(others)) if others else ()
+    mesh_shape = []
+    dcn_shape = []
+    i = 0
+    for name in axis_names:
+        if name == dcn_axis:
+            # a single-axis mesh spans both ICI and DCN on that one axis
+            mesh_shape.append(1 if others else per_slice)
+            dcn_shape.append(slices)
+        else:
+            mesh_shape.append(ici_shape[i])
+            dcn_shape.append(1)
+            i += 1
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape, dcn_shape, devices=devices)
+    return Mesh(grid, axis_names)
