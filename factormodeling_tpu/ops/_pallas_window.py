@@ -1,0 +1,148 @@
+"""Pallas streaming kernels for wide trailing-window time-series ops.
+
+The XLA formulation of ``ts_decay`` / ``ts_rank`` (``timeseries.py``) is a
+``fori_loop`` of W shifted passes; each iteration re-reads and re-writes the
+whole panel in HBM, so a W=150 decay costs ~W full HBM sweeps. These kernels
+stream the panel through VMEM once: the grid walks ``[D_BLK, 128]`` column
+tiles down the date axis, a VMEM scratch carries the previous tile's last W
+rows (the rolling history) across sequential grid steps, and the W-step
+window loop runs entirely on the VPU — HBM traffic drops from O(W·D·N) to
+O(D·N).
+
+Semantics are identical to the XLA path: NaN history padding means a window
+overlapping the series start (or a NaN observation) can never reach a full
+valid count, reproducing ``min_periods=window``. The dispatch in
+``timeseries.py`` is purely a backend choice — TPU takes the kernels, other
+backends keep XLA, tests run the kernels in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on CPU-only installs of some versions
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["decay_streaming", "ts_rank_streaming", "pallas_available"]
+
+_LANES = 128
+
+
+def pallas_available() -> bool:
+    """True when the running backend can execute the compiled kernels."""
+    return pltpu is not None and jax.default_backend() == "tpu"
+
+
+def _date_block(window: int) -> int:
+    """Date-tile height: >= window so the state hand-off copy never
+    self-overlaps, sublane-aligned, defaulting to 512 rows."""
+    return max(512, -(-window // 8) * 8)
+
+
+def _window_body(kernel_step, x_ref, out_ref, state_ref, *, window: int,
+                 d_blk: int):
+    """Shared streaming frame: history init/hand-off around ``kernel_step``.
+
+    ``state_ref`` rows ``[0, W)`` hold the previous tile's last W raw values
+    (NaN before the series starts); rows ``[W, W+d_blk)`` hold this tile.
+    """
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():  # series start: no history yet
+        state_ref[0:window, :] = jnp.full((window, _LANES), jnp.nan,
+                                          state_ref.dtype)
+
+    x = x_ref[0]
+    state_ref[window:window + d_blk, :] = x
+    out_ref[0] = kernel_step(x, state_ref)
+    # hand the last W rows to the next tile (d_blk >= window: no overlap)
+    state_ref[0:window, :] = state_ref[d_blk:d_blk + window, :]
+
+
+def _decay_step(window: int, d_blk: int):
+    def step(x, state_ref):
+        dtype = x.dtype
+        zeros = jnp.zeros((d_blk, _LANES), dtype)
+
+        def body(j, carry):
+            acc, cnt = carry
+            sl = state_ref[pl.ds(window - j, d_blk), :]
+            valid = ~jnp.isnan(sl)
+            acc = acc + (window - j) * jnp.where(valid, sl, 0.0)
+            return acc, cnt + valid.astype(dtype)
+
+        acc, cnt = lax.fori_loop(0, window, body, (zeros, zeros))
+        denom = window * (window + 1) / 2.0
+        return jnp.where(cnt == window, acc / denom, jnp.nan)
+
+    return step
+
+
+def _rank_step(window: int, d_blk: int):
+    def step(x, state_ref):
+        dtype = x.dtype
+        zeros = jnp.zeros((d_blk, _LANES), dtype)
+
+        def body(j, carry):
+            less, eq, cnt = carry
+            sl = state_ref[pl.ds(window - j, d_blk), :]
+            less = less + (sl < x).astype(dtype)
+            eq = eq + (sl == x).astype(dtype)
+            return less, eq, cnt + (~jnp.isnan(sl)).astype(dtype)
+
+        less, eq, cnt = lax.fori_loop(0, window, body, (zeros, zeros, zeros))
+        pct = (less + 0.5 * (eq + 1.0)) / window
+        return jnp.where(cnt == window, pct, jnp.nan)
+
+    return step
+
+
+def _streaming_call(make_step, x: jnp.ndarray, window: int,
+                    interpret: bool) -> jnp.ndarray:
+    """Run a streaming window kernel over a [..., D, N] array."""
+    if pltpu is None:  # guarded import failed: no VMEM scratch space type
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu is unavailable on this install; "
+            "the streaming kernels (and their interpret mode) need it — "
+            "use the XLA ops in factormodeling_tpu.ops.timeseries instead")
+    shape = x.shape
+    d, n = shape[-2], shape[-1]
+    r = 1
+    for s in shape[:-2]:
+        r *= s
+    x3 = x.reshape(r, d, n)
+    d_blk = min(_date_block(window), -(-d // 8) * 8)
+    kernel = functools.partial(
+        _window_body, make_step(window, d_blk), window=window, d_blk=d_blk)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((r, d, n), x.dtype),
+        grid=(r, pl.cdiv(n, _LANES), pl.cdiv(d, d_blk)),
+        in_specs=[pl.BlockSpec((1, d_blk, _LANES), lambda i, j, k: (i, k, j))],
+        out_specs=pl.BlockSpec((1, d_blk, _LANES), lambda i, j, k: (i, k, j)),
+        scratch_shapes=[pltpu.VMEM((window + d_blk, _LANES), x.dtype)],
+        interpret=interpret,
+    )(x3)
+    return out.reshape(shape)
+
+
+def decay_streaming(x: jnp.ndarray, window: int, *,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Linear-decay trailing mean, one-HBM-pass Pallas formulation of
+    ``ts_decay`` (reference ``operations.py:40-48``)."""
+    return _streaming_call(_decay_step, x, window, interpret)
+
+
+def ts_rank_streaming(x: jnp.ndarray, window: int, *,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Fractional rank of the last window element, one-HBM-pass Pallas
+    formulation of ``ts_rank`` (reference ``operations.py:23-32``)."""
+    return _streaming_call(_rank_step, x, window, interpret)

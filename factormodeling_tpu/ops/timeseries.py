@@ -9,7 +9,10 @@ TPU design: arrays are ``float[..., D, N]`` (date axis -2, asset axis -1); a
 "per-symbol rolling op" is a windowed reduction along the date axis applied to
 all N columns at once — ``lax.reduce_window`` for sums/moments, a
 ``fori_loop`` of lag-compares for order statistics (ts_rank) and weighted sums
-(ts_decay). No Python loop over symbols or dates survives tracing.
+(ts_decay). No Python loop over symbols or dates survives tracing. On a TPU
+backend the window-loop ops (ts_rank, ts_decay) dispatch to the Pallas
+streaming kernels of :mod:`._pallas_window` (one HBM pass, VMEM-resident
+window state); every other backend keeps the XLA formulation below.
 """
 
 from __future__ import annotations
@@ -19,6 +22,7 @@ import functools
 import jax.numpy as jnp
 from jax import lax
 
+from factormodeling_tpu.ops import _pallas_window as _pw
 from factormodeling_tpu.ops._window import (
     compaction_order,
     forward_fill,
@@ -26,6 +30,14 @@ from factormodeling_tpu.ops._window import (
     rolling_sum,
     shift,
 )
+
+
+def _use_streaming(x: jnp.ndarray, window: int) -> bool:
+    """Take the Pallas path on TPU for real panels (lane-wide f32 data; tiny
+    inputs stay on XLA where padding to 128 lanes would dominate)."""
+    return (_pw.pallas_available() and x.dtype == jnp.float32
+            and x.ndim >= 2 and x.shape[-1] >= 128 and x.shape[-2] >= 8
+            and window >= 2)
 
 __all__ = [
     "ts_sum",
@@ -124,6 +136,8 @@ def ts_rank(x: jnp.ndarray, window: int) -> jnp.ndarray:
     window (reference ``operations.py:23-32``): pandas
     ``rolling(w, min_periods=w).apply(lambda s: s.rank(pct=True).iloc[-1])``.
     """
+    if _use_streaming(x, window):
+        return _pw.ts_rank_streaming(x, window)
     _, full = _windowed(x, window)
 
     def body(j, carry):
@@ -158,6 +172,8 @@ def ts_decay(x: jnp.ndarray, window: int) -> jnp.ndarray:
     (reference ``operations.py:40-48``)."""
     if window < 1:
         return x
+    if _use_streaming(x, window):
+        return _pw.decay_streaming(x, window)
     filled, full = _windowed(x, window)
 
     def body(j, acc):

@@ -78,3 +78,50 @@ def test_batched_leading_dim(rng):
     for f in range(2):
         exp = po.long_to_dense(po.o_ts_mean(po.dense_to_long(x[f]), 3), D, N)
         np.testing.assert_allclose(got[f], exp, atol=1e-10, equal_nan=True)
+
+
+@pytest.mark.parametrize("window", [2, 9, 45])
+def test_pallas_streaming_kernels_match_xla(rng, window):
+    """The Pallas one-pass window kernels (TPU dispatch path of
+    ts_decay/ts_rank) must equal the XLA formulation, NaNs included."""
+    pytest.importorskip("jax.experimental.pallas.tpu")
+    from factormodeling_tpu.ops._pallas_window import (
+        decay_streaming, ts_rank_streaming)
+
+    # D=60 > the largest window so every case produces real values
+    x = rng.normal(size=(3, 60, 20)).astype(np.float32)
+    x[rng.uniform(size=x.shape) < 0.1] = np.nan
+    xd = jnp.array(x)
+    np.testing.assert_allclose(
+        np.asarray(decay_streaming(xd, window, interpret=True)),
+        np.asarray(ops.ts_decay(xd, window)), atol=1e-6, equal_nan=True)
+    np.testing.assert_allclose(
+        np.asarray(ts_rank_streaming(xd, window, interpret=True)),
+        np.asarray(ops.ts_rank(xd, window)), atol=1e-6, equal_nan=True)
+
+
+def test_pallas_streaming_multi_tile_handoff(rng):
+    """Windows that straddle date-tile boundaries (d > d_blk) must see the
+    previous tile's history through the VMEM state hand-off."""
+    pytest.importorskip("jax.experimental.pallas.tpu")
+    from factormodeling_tpu.ops._pallas_window import (
+        decay_streaming, ts_rank_streaming)
+
+    x = rng.normal(size=(1040, 130)).astype(np.float32)
+    x[rng.uniform(size=x.shape) < 0.05] = np.nan
+    xd = jnp.array(x)
+    for w in (16, 100):
+        np.testing.assert_allclose(
+            np.asarray(decay_streaming(xd, w, interpret=True)),
+            np.asarray(ops.ts_decay(xd, w)), atol=1e-5, equal_nan=True)
+        np.testing.assert_allclose(
+            np.asarray(ts_rank_streaming(xd, w, interpret=True)),
+            np.asarray(ops.ts_rank(xd, w)), atol=1e-5, equal_nan=True)
+
+
+def test_pallas_dispatch_is_tpu_only():
+    """On the CPU test backend the ops must keep the XLA path (the compiled
+    kernels are TPU-only)."""
+    from factormodeling_tpu.ops import _pallas_window as pw
+
+    assert not pw.pallas_available()
