@@ -13,6 +13,8 @@ from factormodeling_tpu.selection.selectors import (  # noqa: F401
     factor_momentum_selector,
     icir_top_selector,
     mvo_selector,
+    pca_selector,
     register_selection_method,
+    regression_selector,
 )
 from factormodeling_tpu.selection.shrinkage import ledoit_wolf_shrinkage  # noqa: F401

@@ -80,6 +80,11 @@ def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
     selector = FACTOR_SELECTION_METHODS.get(method)
     if selector is None:
         raise ValueError(f"Unknown factor selection method: {method}")
+    if window >= factor_ret.shape[0]:
+        # the reference's loop over dates[window:-1] is empty: nothing is
+        # processed (also keeps the covariance selectors' window-sized
+        # dynamic slices in range)
+        return jnp.zeros(factor_ret.shape, factor_ret.dtype)
     ctx = build_selection_context(factors, returns, factor_ret, window,
                                   universe=universe, shift_periods=shift_periods)
     raw = selector(ctx, **(method_kwargs or {}))  # [D, F]

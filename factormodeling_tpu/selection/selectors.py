@@ -35,6 +35,8 @@ __all__ = [
     "icir_top_selector",
     "factor_momentum_selector",
     "mvo_selector",
+    "pca_selector",
+    "regression_selector",
 ]
 
 
@@ -102,23 +104,14 @@ def mvo_selector(ctx: SelectionContext, *, risk_aversion: float = 1.0,
     d_dates, f = ctx.factor_ret.shape
     ret = ctx.factor_ret
     cap = max_weight if max_weight < 1.0 else 1.0
-    window = ctx.window
 
     def solve_one(today_idx):
-        start = jnp.maximum(today_idx - window, 0)
-        win = lax.dynamic_slice(ret, (start, 0), (window, f))  # [W, F]
-        # today and later rows never enter the trailing window (the clamped
-        # start would otherwise leak same-day/future returns for early dates)
-        in_past = (start + jnp.arange(window)) < today_idx
-        win = jnp.where(in_past[:, None], win, jnp.nan)
-        mu = jnp.nanmean(win, axis=0)
-        if use_shrinkage:
-            cov = ledoit_wolf_shrinkage(win)
-            cov = 0.5 * (cov + cov.T)
-        else:
-            # pandas DataFrame.cov(): pairwise-complete over jointly-valid
-            # rows with per-pair means, ddof=1 — NaNs must not poison it
-            cov = masked_pairwise_cov(win)
+        # _windowed_moments excludes today and later rows from the trailing
+        # window (the clamped start would otherwise leak same-day/future
+        # returns for early dates); without shrinkage it uses the pandas
+        # DataFrame.cov() pairwise-complete rule so NaNs don't poison it
+        mu, cov = _windowed_moments(ctx, today_idx,
+                                    use_shrinkage=use_shrinkage)
         prob = BoxQPProblem(
             q=-mu, lo=jnp.zeros(f, ret.dtype), hi=jnp.full(f, cap, ret.dtype),
             E=jnp.ones((1, f), ret.dtype), b=jnp.ones(1, ret.dtype),
@@ -133,10 +126,92 @@ def mvo_selector(ctx: SelectionContext, *, risk_aversion: float = 1.0,
     return lax.map(solve_one, idx, batch_size=batch_size)  # [D, F]
 
 
+def _windowed_moments(ctx: SelectionContext, today_idx, *, use_shrinkage: bool):
+    """(mu [F], cov [F, F]) of the trailing factor-return window ending the
+    day before ``today_idx`` — the shared plumbing of the covariance-based
+    selectors (mvo / pca / regression)."""
+    window, f = ctx.window, ctx.factor_ret.shape[1]
+    start = jnp.maximum(today_idx - window, 0)
+    win = lax.dynamic_slice(ctx.factor_ret, (start, 0), (window, f))
+    in_past = (start + jnp.arange(window)) < today_idx
+    win = jnp.where(in_past[:, None], win, jnp.nan)
+    mu = jnp.nanmean(win, axis=0)
+    if use_shrinkage:
+        cov = ledoit_wolf_shrinkage(win)
+        cov = 0.5 * (cov + cov.T)
+    else:
+        cov = masked_pairwise_cov(win)
+    return mu, cov
+
+
+def pca_selector(ctx: SelectionContext, *, use_shrinkage: bool = True,
+                 batch_size: int = 64, **_ignored) -> jnp.ndarray:
+    """PCA blend: weight factors by the leading eigenvector of the trailing
+    window's factor-return covariance (the dominant common direction of
+    factor performance), sign-oriented by the window's mean returns.
+
+    Native extension beyond the reference registry (BASELINE.json north-star
+    "PCA/regression blend" clause); same plugin contract as the reference
+    methods. Negative loadings clip to 0 (long-only factor weights); an
+    all-clipped or non-finite window falls back to zero weights like the
+    reference's mvo failure path.
+    """
+
+    def solve_one(today_idx):
+        mu, cov = _windowed_moments(ctx, today_idx,
+                                    use_shrinkage=use_shrinkage)
+        finite = jnp.all(jnp.isfinite(cov)) & jnp.all(jnp.isfinite(mu))
+        cov = jnp.where(finite, cov, jnp.eye(cov.shape[0], dtype=cov.dtype))
+        _, vecs = jnp.linalg.eigh(cov)         # ascending eigenvalues
+        lead = vecs[:, -1]
+        lead = lead * jnp.sign(jnp.where(jnp.dot(lead, mu) == 0.0, 1.0,
+                                         jnp.dot(lead, mu)))
+        w = jnp.maximum(lead, 0.0)
+        return jnp.where(finite, w, 0.0)
+
+    idx = jnp.arange(ctx.factor_ret.shape[0])
+    return lax.map(solve_one, idx, batch_size=batch_size)  # [D, F]
+
+
+def regression_selector(ctx: SelectionContext, *, ridge: float = 1e-4,
+                        use_shrinkage: bool = True, batch_size: int = 64,
+                        **_ignored) -> jnp.ndarray:
+    """Regression blend: closed-form characteristic-portfolio weights
+    ``w proportional to (Sigma + ridge*I)^-1 mu`` over the trailing window —
+    the coefficients of regressing a unit-return target on the factor-return
+    history, i.e. an unconstrained Markowitz tangency direction.
+
+    Native extension beyond the reference registry (BASELINE.json north-star
+    "PCA/regression blend" clause). Negative weights clip to 0; non-finite
+    windows fall back to zero weights.
+    """
+
+    def solve_one(today_idx):
+        mu, cov = _windowed_moments(ctx, today_idx,
+                                    use_shrinkage=use_shrinkage)
+        f = cov.shape[0]
+        finite = jnp.all(jnp.isfinite(cov)) & jnp.all(jnp.isfinite(mu))
+        cov = jnp.where(finite, cov, jnp.eye(f, dtype=cov.dtype))
+        mu0 = jnp.where(finite, mu, 0.0)
+        tr = jnp.trace(cov) / f
+        a = cov + (ridge * jnp.maximum(tr, 1.0)) * jnp.eye(f, dtype=cov.dtype)
+        w = jnp.linalg.solve(a, mu0)
+        # a non-PSD pairwise cov can make `a` singular with finite inputs;
+        # guard the solve output too (mvo does the same post-solve)
+        finite &= jnp.all(jnp.isfinite(w))
+        w = jnp.maximum(w, 0.0)
+        return jnp.where(finite, w, 0.0)
+
+    idx = jnp.arange(ctx.factor_ret.shape[0])
+    return lax.map(solve_one, idx, batch_size=batch_size)  # [D, F]
+
+
 FACTOR_SELECTION_METHODS: dict[str, Callable] = {
     "icir_top": icir_top_selector,
     "momentum": factor_momentum_selector,
     "mvo": mvo_selector,
+    "pca": pca_selector,
+    "regression": regression_selector,
 }
 
 

@@ -180,3 +180,68 @@ def test_mvo_selector_no_lookahead_for_early_dates(rng):
 
     a, b = run(factor_ret), run(poisoned)
     np.testing.assert_allclose(a[: W // 2], b[: W // 2], atol=1e-12)
+
+
+def test_pca_selector_matches_numpy_eig(rng):
+    """pca weights = clipped, mean-oriented leading eigenvector of the
+    trailing LW-shrunk factor-return covariance, checked per date vs numpy."""
+    factors, returns, factor_ret, *_ = make_inputs(rng)
+    sel = rolling_selection(jnp.array(factors), jnp.array(returns),
+                            jnp.array(factor_ret), W, method="pca")
+    sel = np.asarray(sel)
+    assert (sel >= 0).all()
+    live = sel.sum(axis=1) > 0
+    assert live.any()
+    np.testing.assert_allclose(sel[live].sum(axis=1), 1.0, atol=1e-5)
+
+    for t in range(W, D - 1):
+        win = factor_ret[t - W:t]
+        cov = np.asarray(ledoit_wolf_shrinkage(jnp.array(win)))
+        cov = 0.5 * (cov + cov.T)
+        vals, vecs = np.linalg.eigh(cov)
+        lead = vecs[:, -1]
+        mu = win.mean(axis=0)
+        if np.dot(lead, mu) < 0:
+            lead = -lead
+        w = np.maximum(lead, 0.0)
+        if w.sum() <= 0:
+            continue
+        np.testing.assert_allclose(sel[t], w / w.sum(), atol=1e-4,
+                                   err_msg=str(t))
+
+
+def test_regression_selector_matches_numpy_solve(rng):
+    """regression weights = clipped (Sigma + ridge tr/F I)^-1 mu, normalized."""
+    factors, returns, factor_ret, *_ = make_inputs(rng)
+    ridge = 1e-4
+    sel = np.asarray(rolling_selection(
+        jnp.array(factors), jnp.array(returns), jnp.array(factor_ret), W,
+        method="regression", method_kwargs={"ridge": ridge}))
+    assert (sel >= 0).all()
+
+    for t in range(W, D - 1):
+        win = factor_ret[t - W:t]
+        cov = np.asarray(ledoit_wolf_shrinkage(jnp.array(win)))
+        cov = 0.5 * (cov + cov.T)
+        mu = win.mean(axis=0)
+        a = cov + ridge * max(np.trace(cov) / F, 1.0) * np.eye(F)
+        w = np.maximum(np.linalg.solve(a, mu), 0.0)
+        if w.sum() <= 0:
+            assert sel[t].sum() == 0.0
+            continue
+        np.testing.assert_allclose(sel[t], w / w.sum(), atol=1e-4,
+                                   err_msg=str(t))
+
+
+def test_covariance_selectors_zero_on_nan_windows(rng):
+    """NaN factor-return windows -> zero weights (the reference's failure
+    fallback) for both new covariance-based selectors."""
+    factors, returns, factor_ret, *_ = make_inputs(rng)
+    factor_ret = factor_ret.copy()
+    factor_ret[W + 2] = np.nan  # poisons windows covering this date
+    for method in ("pca", "regression"):
+        sel = np.asarray(rolling_selection(
+            jnp.array(factors), jnp.array(returns), jnp.array(factor_ret), W,
+            method=method))
+        poisoned = slice(W + 3, min(W + 2 + W, D - 1))
+        assert (sel[poisoned] == 0).all(), method
