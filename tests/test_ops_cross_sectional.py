@@ -91,3 +91,46 @@ def test_log(rng):
     x = np.abs(make_case(rng)) + 0.1
     got = np.asarray(ops.log(jnp.array(x)))
     np.testing.assert_allclose(got, np.log(x), equal_nan=True, atol=1e-12)
+
+
+def test_avg_rank_adversarial_values():
+    """The single-key-sort rank core must handle +-inf, mass ties, signed
+    zeros, single-valid and all-NaN rows exactly like scipy.rankdata."""
+    from scipy.stats import rankdata
+
+    from factormodeling_tpu.ops._rank import avg_rank, segment_avg_rank
+
+    rows = np.array([
+        [1.0, np.inf, -np.inf, np.nan, np.inf, 0.0],
+        [2.0, 2.0, 2.0, 2.0, 2.0, 2.0],
+        [np.nan] * 5 + [3.0],
+        [np.nan] * 6,
+        [-0.0, 0.0, 1.0, -1.0, np.nan, 0.0],
+    ], dtype=np.float32)
+    got = np.asarray(avg_rank(jnp.array(rows), axis=-1))
+    for i, row in enumerate(rows):
+        v = ~np.isnan(row)
+        if not v.any():
+            assert np.isnan(got[i]).all()
+            continue
+        exp = np.full(row.shape, np.nan)
+        exp[v] = rankdata(row[v])
+        np.testing.assert_allclose(got[i], exp, equal_nan=True,
+                                   err_msg=str(i))
+
+    segs = np.broadcast_to(np.array([0, 0, 1, 1, 0, -1], np.int32),
+                           rows.shape)
+    r, c = segment_avg_rank(jnp.array(rows), jnp.array(segs), axis=-1)
+    r, c = np.asarray(r), np.asarray(c)
+    for i, row in enumerate(rows):
+        for s in (0, 1):
+            m = segs[i] == s
+            vals = row[m]
+            v = ~np.isnan(vals)
+            if v.any():
+                np.testing.assert_allclose(np.sort(r[i][m][v]),
+                                           np.sort(rankdata(vals[v])),
+                                           err_msg=f"{i},{s}")
+            assert (c[i][m] == v.sum()).all(), (i, s)
+        assert (c[i][segs[i] < 0] == 0).all()
+        assert np.isnan(r[i][segs[i] < 0]).all()
