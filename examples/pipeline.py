@@ -190,6 +190,10 @@ def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
         "icir": ("icir_top", {"top_x": 3, "icir_threshold": -1}),
         "momentum": ("momentum", {"max_weight": 0.3}),
         "mvo": ("mvo", {"max_weight": 0.3, "turnover_penalty": 0.5}),
+        # native extensions beyond the reference registry (north-star
+        # "PCA/regression blend")
+        "pca": ("pca", {}),
+        "regression": ("regression", {"ridge": 1e-3}),
     }
     factor_weights: dict = {}
     for label, (method, kwargs) in selector_specs.items():

@@ -22,7 +22,8 @@ from factormodeling_tpu.selection import ledoit_wolf_shrinkage as _lw_dense
 from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_dense
 
 __all__ = ["icir_top_selector", "factor_momentum_selector",
-           "ledoit_wolf_shrinkage", "mvo_selector"]
+           "ledoit_wolf_shrinkage", "mvo_selector", "pca_selector",
+           "regression_selector"]
 
 
 def icir_top_selector(metrics_df, factors_win, returns_win, factor_ret_win,
@@ -74,12 +75,7 @@ def mvo_selector(metrics_df, factors_win, returns_win, factor_ret_win, today,
     zero weights, the reference's fallback)."""
     cols = factor_ret_win.columns
     f = len(cols)
-    mu = factor_ret_win.mean(axis=0).to_numpy()
-    if use_shrinkage:
-        cov = np.asarray(ledoit_wolf_shrinkage(factor_ret_win))
-    else:
-        cov = factor_ret_win.cov().to_numpy()
-    cov = 0.5 * (cov + cov.T)
+    mu, cov = _window_moments(factor_ret_win, use_shrinkage)
     prev = (previous_weights.reindex(cols).fillna(0.0).to_numpy()
             if previous_weights is not None else np.zeros(f))
     cap = min(max_weight, 1.0)
@@ -99,3 +95,67 @@ def mvo_selector(metrics_df, factors_win, returns_win, factor_ret_win, today,
     if vec.sum() > 0:
         vec = vec / vec.sum()
     return vec
+
+
+def _window_moments(factor_ret_win, use_shrinkage):
+    """(mu, symmetrized cov) of a factor-return window — the shared preamble
+    of the covariance-based plugins (mvo/pca/regression; the dense analog is
+    ``selection.selectors._windowed_moments``)."""
+    mu = factor_ret_win.mean(axis=0).to_numpy()
+    if use_shrinkage:
+        cov = np.asarray(ledoit_wolf_shrinkage(factor_ret_win))
+    else:
+        cov = factor_ret_win.cov().to_numpy()
+    return mu, 0.5 * (cov + cov.T)
+
+
+def _clip_normalize(w, cols, today):
+    """Long-only clip + sum-1 renormalization, the reference plugins' tail
+    (``factor_selection_methods.py:172-174``)."""
+    vec = pd.Series(np.maximum(w, 0.0), index=cols, name=today)
+    if vec.sum() > 0:
+        vec = vec / vec.sum()
+    return vec
+
+
+def pca_selector(metrics_df, factors_win, returns_win, factor_ret_win, today,
+                 window, use_shrinkage=True, **kwargs):
+    """PCA blend: leading eigenvector of the window's factor-return
+    covariance, oriented by mean returns, long-only clipped, normalized.
+
+    Native extension beyond the reference registry (the north-star
+    "PCA/regression blend"); same plugin signature as the reference methods.
+    """
+    cols = factor_ret_win.columns
+    mu, cov = _window_moments(factor_ret_win, use_shrinkage)
+    if not (np.all(np.isfinite(cov)) and np.all(np.isfinite(mu))):
+        return pd.Series(0.0, index=cols, name=today)
+    _, vecs = np.linalg.eigh(cov)
+    lead = vecs[:, -1]
+    if np.dot(lead, mu) < 0:
+        lead = -lead
+    return _clip_normalize(lead, cols, today)
+
+
+def regression_selector(metrics_df, factors_win, returns_win, factor_ret_win,
+                        today, window, ridge=1e-4, use_shrinkage=True,
+                        **kwargs):
+    """Regression blend: characteristic-portfolio weights
+    ``(Sigma + ridge*max(tr/F,1)*I)^-1 mu``, long-only clipped, normalized.
+
+    Native extension beyond the reference registry (the north-star
+    "PCA/regression blend"); same plugin signature as the reference methods.
+    """
+    cols = factor_ret_win.columns
+    f = len(cols)
+    mu, cov = _window_moments(factor_ret_win, use_shrinkage)
+    if not (np.all(np.isfinite(cov)) and np.all(np.isfinite(mu))):
+        return pd.Series(0.0, index=cols, name=today)
+    a = cov + ridge * max(np.trace(cov) / f, 1.0) * np.eye(f)
+    try:
+        w = np.linalg.solve(a, mu)
+    except np.linalg.LinAlgError:
+        return pd.Series(0.0, index=cols, name=today)
+    if not np.all(np.isfinite(w)):
+        return pd.Series(0.0, index=cols, name=today)
+    return _clip_normalize(w, cols, today)

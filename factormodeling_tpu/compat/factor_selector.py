@@ -39,9 +39,13 @@ FACTOR_SELECTION_METHODS = {
     "icir_top": fsm.icir_top_selector,
     "momentum": fsm.factor_momentum_selector,
     "mvo": fsm.mvo_selector,
+    # native extensions (north-star "PCA/regression blend"), same contract
+    "pca": fsm.pca_selector,
+    "regression": fsm.regression_selector,
 }
 
-_DENSE_METHODS = frozenset(["icir_top", "momentum", "mvo"])
+_DENSE_METHODS = frozenset(["icir_top", "momentum", "mvo", "pca",
+                            "regression"])
 
 _METRIC_ORDER = ("IC", "IC_IR", "rank_IC", "rank_IC_IR",
                  "factor_return_tstat", "factor_return_pvalue",

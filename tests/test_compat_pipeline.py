@@ -427,3 +427,31 @@ def test_compat_decay_sensitivity_matches_per_window_loop(rng, tmp_path):
     assert s.output_returns and not s.plot  # reference side effects
     fig.savefig(tmp_path / "compat_decay.png")
     assert (tmp_path / "compat_decay.png").stat().st_size > 5000
+
+
+@pytest.mark.parametrize("method", ["pca", "regression"])
+def test_compat_pca_regression_dense_matches_plugin_loop(rng, method):
+    """The dense fast path for the native pca/regression extensions must
+    reproduce the reference-style per-date plugin loop bit-for-bit (the
+    plugin path is forced by registering the same plugin under an alias
+    outside the dense set)."""
+    from factormodeling_tpu.compat import factor_selector as fs
+
+    factors = make_factors(rng)
+    returns = make_panel(rng, nan_frac=0.0).rename("ret")
+    fr = pd.DataFrame(rng.normal(scale=0.01, size=(D, F)),
+                      index=pd.RangeIndex(D), columns=NAMES)
+
+    dense = fs.FactorSelector(factors, returns, fr, window=W,
+                              method=method).prepare_selection()
+
+    alias = f"{method}_plugin_alias"
+    fs.FACTOR_SELECTION_METHODS[alias] = fs.FACTOR_SELECTION_METHODS[method]
+    try:
+        looped = fs.FactorSelector(factors, returns, fr, window=W,
+                                   method=alias).prepare_selection()
+    finally:
+        del fs.FACTOR_SELECTION_METHODS[alias]
+
+    assert list(dense.index) == list(looped.index)
+    np.testing.assert_allclose(dense.to_numpy(), looped.to_numpy(), atol=1e-5)
