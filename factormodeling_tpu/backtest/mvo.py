@@ -36,6 +36,11 @@ from jax import lax
 from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.backtest.weights import equal_weights, leg_masks
 from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_lowrank
+from factormodeling_tpu.solvers.portfolio import (
+    equal_leg_fallback as _x0_legs,
+    leg_constraints,
+    legs_feasible,
+)
 
 __all__ = ["mvo_weights", "mvo_turnover_weights"]
 
@@ -72,16 +77,6 @@ def _shrunk_terms(c: jnp.ndarray, t_used, lam: float, dtype):
     return alpha, s_row
 
 
-def _x0_legs(signal_row: jnp.ndarray) -> jnp.ndarray:
-    """The reference's solver-failure fallback: equal weights per leg
-    (``portfolio_simulation.py:387-390``)."""
-    pos = signal_row > 0
-    neg = signal_row < 0
-    cp = jnp.maximum(pos.sum(), 1).astype(signal_row.dtype)
-    cn = jnp.maximum(neg.sum(), 1).astype(signal_row.dtype)
-    return pos.astype(signal_row.dtype) / cp - neg.astype(signal_row.dtype) / cn
-
-
 def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
                s: SimulationSettings, turnover: bool):
     """One date's MVO solve with the full fallback ladder.
@@ -98,10 +93,7 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     alpha, s_row = _shrunk_terms(c, t_used, s.shrinkage_intensity, dtype)
     s_vec = jnp.where(jnp.arange(s.lookback_period) < t_used, s_row, 0.0)
 
-    lo = jnp.where(pos, 0.0, jnp.where(neg, -s.max_weight, 0.0)).astype(dtype)
-    hi = jnp.where(pos, s.max_weight, 0.0).astype(dtype)
-    E = jnp.stack([pos.astype(dtype), neg.astype(dtype)])
-    b = jnp.asarray([1.0, -1.0], dtype)
+    lo, hi, E, b = leg_constraints(signal_row, s.max_weight, dtype)
     if turnover:
         q = (-s.return_weight) * jnp.nan_to_num(signal_row).astype(dtype)
         l1 = jnp.asarray(s.turnover_penalty, dtype)
@@ -118,8 +110,8 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
                              rho=s.qp_rho, iters=s.qp_iters)
     w = res.x
 
-    feasible = (pos.sum() * s.max_weight >= 1.0) & (neg.sum() * s.max_weight >= 1.0)
-    solver_ok = jnp.all(jnp.isfinite(w)) & feasible & (t_used >= 2)
+    solver_ok = (jnp.all(jnp.isfinite(w))
+                 & legs_feasible(signal_row, s.max_weight) & (t_used >= 2))
     w = jnp.where(solver_ok, w, _x0_legs(signal_row))
 
     if turnover:

@@ -45,6 +45,7 @@ __all__ = [
     "ewma_weights",
     "factor_covariance",
     "full_covariance",
+    "optimal_weights",
     "pca",
     "portfolio_variance",
     "risk_matvec",
@@ -333,3 +334,49 @@ def full_covariance(model: RiskModel) -> jnp.ndarray:
     """Materialize ``Sigma`` at ``[N, N]`` — for tests / small universes only."""
     b = model.loadings
     return (b * model.factor_var[None, :]) @ b.T + jnp.diag(model.idio_var)
+
+
+def optimal_weights(model: RiskModel, signal: jnp.ndarray, *,
+                    max_weight: float = 0.03, return_weight: float = 0.0,
+                    turnover_penalty: float = 0.0,
+                    prev_weights: jnp.ndarray | None = None,
+                    qp_iters: int = 500, rho: float = 2.0):
+    """Dollar-neutral long/short MVO under the statistical risk model.
+
+    The backtest engine's constraint set (reference
+    ``portfolio_simulation.py:402-421``): long leg sums to +1, short to -1,
+    sign-consistent boxes ``[0, max_weight]`` / ``[-max_weight, 0]``,
+    zero-signal names pinned to 0 — but with the portfolio variance measured
+    by the factored model ``Sigma = B diag(f) B' + diag(idio)`` instead of a
+    trailing sample covariance. The per-asset idiosyncratic diagonal rides
+    the vector-alpha Woodbury path of
+    :func:`~factormodeling_tpu.solvers.admm_solve_lowrank`, so the ``N x N``
+    matrix never materializes (O(N*k) per ADMM iteration).
+
+    Batched over leading axes of ``signal`` via ``vmap``-ability; returns
+    ``(weights, primal_residual, solver_ok)`` where failed/infeasible solves
+    fall back to equal-weight legs like the reference (``:452-459``).
+    """
+    from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_lowrank
+    from factormodeling_tpu.solvers.portfolio import (
+        equal_leg_fallback,
+        leg_constraints,
+        legs_feasible,
+    )
+
+    sig = jnp.nan_to_num(signal).astype(model.loadings.dtype)
+    dtype = sig.dtype
+    n = sig.shape[-1]
+    lo, hi, E, b = leg_constraints(sig, max_weight, dtype)
+    prev = (jnp.zeros(n, dtype) if prev_weights is None
+            else jnp.nan_to_num(prev_weights).astype(dtype))
+    prob = BoxQPProblem(
+        q=(-return_weight) * sig, lo=lo, hi=hi, E=E, b=b,
+        l1=jnp.asarray(turnover_penalty, dtype), center=prev)
+    # reference objective is w' Sigma w (not halved): P = 2 Sigma
+    res = admm_solve_lowrank(2.0 * model.idio_var, model.loadings.T,
+                             2.0 * model.factor_var, prob,
+                             rho=rho, iters=qp_iters)
+    w = res.x
+    ok = jnp.all(jnp.isfinite(w)) & legs_feasible(sig, max_weight)
+    return (jnp.where(ok, w, equal_leg_fallback(sig)), res.primal_residual, ok)

@@ -173,10 +173,13 @@ def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
 def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
                        prob: BoxQPProblem, *, rho: float = 2.0,
                        iters: int = 500, relax: float = 1.6) -> ADMMResult:
-    """Low-rank path: P = alpha I + V' diag(s) V with V: [T, n], T << n.
+    """Low-rank path: P = diag(alpha) + V' diag(s) V with V: [T, n], T << n.
 
-    This is the asset-MVO shape: V holds T centered return observations and
-    alpha the shrinkage/jitter diagonal (``portfolio_simulation.py:315-374``).
+    ``alpha`` is a scalar (the backtest's shrinkage/jitter identity,
+    ``portfolio_simulation.py:315-374``, with V the centered return window)
+    or an ``[n]`` vector (a statistical risk model's per-asset idiosyncratic
+    variances, with V the factor loadings' transpose — see
+    :func:`factormodeling_tpu.risk.optimal_weights`).
     (P + rho I)^{-1} is applied by Woodbury with one T x T Cholesky — O(nT)
     per iteration, no N x N matrix ever formed. ``rho`` is the initial
     penalty; residual balancing adapts it every ``_ADAPT_EVERY`` iterations
@@ -184,8 +187,9 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
     iterations run.
     """
     t, n = V.shape
-    # mean(diag P) = alpha + sum_k s_k V_kj^2 / n
-    scale = jnp.maximum(alpha + (s[:, None] * V * V).sum() / n, 1e-12)
+    alpha = jnp.asarray(alpha)
+    # mean(diag P) = mean(alpha) + sum_k s_k V_kj^2 / n
+    scale = jnp.maximum(jnp.mean(alpha) + (s[:, None] * V * V).sum() / n, 1e-12)
     a = alpha / scale
     ss = s / scale
     q = prob.q / scale
@@ -193,17 +197,25 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
 
     ss_safe = jnp.where(ss > 0, ss, 1.0)
     inv_ss = jnp.diag(jnp.where(ss > 0, 1.0 / ss_safe, 1e12))
-    vvt = V @ V.T                                    # [T, T], factored once
+    vector_alpha = alpha.ndim == 1                   # static at trace time
+    if not vector_alpha:
+        vvt = V @ V.T                                # [T, T], factored once
 
     def make_solver(rho):
-        d = a + rho
-        # Woodbury inner matrix: diag(1/ss) + V V' / d  (ss == 0 rows disabled)
-        inner_chol = jax.scipy.linalg.cho_factor(inv_ss + vvt / d)
+        d = a + rho                                  # scalar or [n]
+        # Woodbury: (D + V'SV)^-1 = D^-1 - D^-1 V'(S^-1 + V D^-1 V')^-1 V D^-1
+        # Scalar d reuses the cached V V' (each adaptive-rho refactor is then
+        # O(T^2 + T^3)); only vector d pays the O(n T^2) rebuild per refactor.
+        vdv = (V / d) @ V.T if vector_alpha else vvt / d
+        inner_chol = jax.scipy.linalg.cho_factor(inv_ss + vdv)
 
         def solve_m(r):
-            vr = V @ r
-            corr = V.T @ jax.scipy.linalg.cho_solve(inner_chol, vr / d)
-            return (r - corr) / d
+            # r is [n] or [n, K] (the equality columns E'); a vector d
+            # divides along the asset axis either way
+            dd = d[:, None] if (vector_alpha and r.ndim == 2) else d
+            rd = r / dd
+            corr = (V.T @ jax.scipy.linalg.cho_solve(inner_chol, V @ rd)) / dd
+            return rd - corr
 
         return solve_m
 

@@ -1,0 +1,47 @@
+"""Long/short leg constraint construction shared by the MVO consumers.
+
+The reference's per-date MVO problems (``portfolio_simulation.py:402-421``)
+all use the same constraint set — long leg sums to +1, short to -1,
+sign-consistent boxes, zero-signal names pinned to 0 — and the same
+solver-failure fallback of equal weights per leg (``:452-459``). Both the
+backtest engine (:mod:`factormodeling_tpu.backtest.mvo`, trailing sample
+covariance) and the risk-model optimizer
+(:func:`factormodeling_tpu.risk.optimal_weights`, factored covariance)
+consume these helpers so the semantics cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["leg_constraints", "equal_leg_fallback", "legs_feasible"]
+
+
+def leg_constraints(signal_row: jnp.ndarray, max_weight: float, dtype):
+    """``(lo, hi, E, b)`` of the reference MVO constraint set for one day's
+    signal row (``portfolio_simulation.py:402-421``)."""
+    pos = signal_row > 0
+    neg = signal_row < 0
+    lo = jnp.where(pos, 0.0, jnp.where(neg, -max_weight, 0.0)).astype(dtype)
+    hi = jnp.where(pos, max_weight, 0.0).astype(dtype)
+    E = jnp.stack([pos.astype(dtype), neg.astype(dtype)])
+    b = jnp.asarray([1.0, -1.0], dtype)
+    return lo, hi, E, b
+
+
+def equal_leg_fallback(signal_row: jnp.ndarray) -> jnp.ndarray:
+    """The reference's solver-failure fallback: equal weights per leg
+    (``portfolio_simulation.py:387-390, 452-459``)."""
+    pos = signal_row > 0
+    neg = signal_row < 0
+    cp = jnp.maximum(pos.sum(), 1).astype(signal_row.dtype)
+    cn = jnp.maximum(neg.sum(), 1).astype(signal_row.dtype)
+    return pos.astype(signal_row.dtype) / cp - neg.astype(signal_row.dtype) / cn
+
+
+def legs_feasible(signal_row: jnp.ndarray, max_weight: float) -> jnp.ndarray:
+    """Whether each leg can reach +-1 under the per-name cap."""
+    pos = signal_row > 0
+    neg = signal_row < 0
+    return ((pos.sum() * max_weight >= 1.0)
+            & (neg.sum() * max_weight >= 1.0))
