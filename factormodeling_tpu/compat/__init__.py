@@ -19,7 +19,70 @@ jitted kernels, and realigns results to the caller's index. This is the
 "'jax' backend behind the existing plugin boundary" of BASELINE.json's north
 star: the pandas surface is unchanged, the compute runs on device.
 
+The reference's driver notebook imports these modules by their *bare*
+top-level names (``pipeline.ipynb`` cell 3: ``import composite_factor``,
+``from operations import ts_decay``, ``from portfolio_simulation import
+...``).  :func:`install` makes those statements resolve to this backend, so
+the notebook runs unmodified::
+
+    import factormodeling_tpu.compat as compat
+    compat.install()          # before the notebook's own imports
+    import operations         # -> factormodeling_tpu.compat.operations
+
 Precision note: conversions use the active JAX default float width — enable
 ``jax.config.update("jax_enable_x64", True)`` for bit-level pandas parity;
 the float32 default is the TPU-native fast path.
 """
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+#: reference module name -> compat submodule (1:1). pipeline.ipynb cell 3
+#: imports six of these bare names directly; factor_selection_methods is on
+#: the bare namespace transitively (reference factor_selector.py:6).
+REFERENCE_MODULES = (
+    "operations",
+    "factor_selector",
+    "factor_selection_methods",
+    "composite_factor",
+    "portfolio_simulation",
+    "portfolio_analyzer",
+    "multi_manager",
+)
+
+
+def install(*, overwrite: bool = False) -> list[str]:
+    """Register the compat modules in ``sys.modules`` under the reference's
+    bare top-level names, so ``pipeline.ipynb``'s imports run unmodified.
+
+    Existing top-level modules with those names are left alone unless
+    ``overwrite=True`` (so a checkout that has the reference on ``sys.path``
+    keeps winning until the caller opts in). All seven compat modules are
+    imported before any bare name is bound, so a failing import (e.g. a
+    missing plotting dependency) leaves ``sys.modules`` untouched rather
+    than half-shadowed. Returns the names installed.
+    """
+    mods = {name: importlib.import_module(f"factormodeling_tpu.compat.{name}")
+            for name in REFERENCE_MODULES}
+    installed = []
+    for name, mod in mods.items():
+        if not overwrite and name in sys.modules:
+            continue
+        sys.modules[name] = mod
+        installed.append(name)
+    return installed
+
+
+def uninstall() -> list[str]:
+    """Undo :func:`install`: drop any bare names that point at compat
+    modules (names bound to something else are untouched)."""
+    removed = []
+    for name in REFERENCE_MODULES:
+        mod = sys.modules.get(name)
+        if mod is not None and getattr(mod, "__name__", "").startswith(
+                "factormodeling_tpu.compat."):
+            del sys.modules[name]
+            removed.append(name)
+    return removed

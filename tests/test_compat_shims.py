@@ -1,0 +1,67 @@
+"""The "pipeline.ipynb runs unmodified" shim: ``compat.install()`` must make
+the reference notebook's bare top-level imports (cell 3) resolve to the
+TPU-backed compat modules, and ``uninstall()`` must undo it cleanly."""
+
+import sys
+
+import factormodeling_tpu.compat as compat
+
+# the reference notebook's import cell, verbatim (pipeline.ipynb cell 3)
+_NOTEBOOK_CELL_3 = """
+from composite_factor import plot_factor_distributions, \
+    composite_factor_calculation, weighted_composite_factor, \
+    plot_quantile_backtests_log
+from operations import ts_decay
+from portfolio_simulation import SimulationSettings, Simulation
+from factor_selector import FactorSelector, single_factor_metrics
+from portfolio_analyzer import PortfolioAnalyzer
+from multi_manager import run_multimanager_backtest
+"""
+
+
+def test_install_makes_notebook_imports_resolve():
+    installed = compat.install()
+    try:
+        assert set(installed) == set(compat.REFERENCE_MODULES)
+        ns: dict = {}
+        exec(_NOTEBOOK_CELL_3, ns)
+        # every name the notebook pulls in is the compat object
+        from factormodeling_tpu.compat.operations import ts_decay
+        from factormodeling_tpu.compat.portfolio_simulation import Simulation
+
+        assert ns["ts_decay"] is ts_decay
+        assert ns["Simulation"] is Simulation
+        assert sys.modules["operations"].__name__ == (
+            "factormodeling_tpu.compat.operations")
+    finally:
+        removed = compat.uninstall()
+    assert set(removed) == set(compat.REFERENCE_MODULES)
+    assert "operations" not in sys.modules
+
+
+def test_install_respects_existing_modules():
+    import types
+
+    sentinel = types.ModuleType("operations")
+    sys.modules["operations"] = sentinel
+    try:
+        installed = compat.install()
+        assert "operations" not in installed
+        assert sys.modules["operations"] is sentinel
+        # overwrite=True takes the name over
+        compat.install(overwrite=True)
+        assert sys.modules["operations"].__name__ == (
+            "factormodeling_tpu.compat.operations")
+    finally:
+        compat.uninstall()
+        sys.modules.pop("operations", None)
+
+
+def test_install_is_idempotent():
+    try:
+        first = compat.install()
+        second = compat.install()
+        assert second == []  # already present, nothing re-bound
+        assert set(first) == set(compat.REFERENCE_MODULES)
+    finally:
+        compat.uninstall()
