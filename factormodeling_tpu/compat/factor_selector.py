@@ -114,6 +114,11 @@ class FactorSelector:
             sel = self._plugin_selection()
         else:
             raise ValueError(f"Unknown factor selection method: {self.method}")
+        if not sel.empty:
+            # the reference names both axes (factor_selector.py:131-132);
+            # the notebook's CSV round-trip (cells 13->16) keys on them
+            sel.index.name = "date"
+            sel.columns.name = "factor"
         self.factor_selection = sel
         return sel
 
