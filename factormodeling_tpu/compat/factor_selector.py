@@ -114,11 +114,12 @@ class FactorSelector:
             sel = self._plugin_selection()
         else:
             raise ValueError(f"Unknown factor selection method: {self.method}")
-        if not sel.empty:
-            # the reference names both axes (factor_selector.py:131-132);
-            # the notebook's CSV round-trip (cells 13->16) keys on them
-            sel.index.name = "date"
-            sel.columns.name = "factor"
+        # the reference names both axes (factor_selector.py:131-132, guarded
+        # by `if not empty` there); the notebook's CSV round-trip (cells
+        # 13->16) keys on them. We name unconditionally — one contract, and
+        # the empty frame still round-trips with its 'date' header.
+        sel.index.name = "date"
+        sel.columns.name = "factor"
         self.factor_selection = sel
         return sel
 

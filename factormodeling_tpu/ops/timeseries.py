@@ -108,8 +108,27 @@ def _ts_moments(x: jnp.ndarray, window: int):
     s1 = rolling_sum(filled, window, axis=_DATE_AXIS)
     s2 = rolling_sum(filled * filled, window, axis=_DATE_AXIS)
     mean = s1 / window
+    if window <= 1:
+        # ddof=1 with one observation: pandas std is NaN everywhere
+        return mean, jnp.full_like(mean, jnp.nan), full
     # ddof=1 sample variance, clamped at 0 against roundoff
     var = jnp.maximum(s2 - s1 * mean, 0.0) / (window - 1)
+    # Pandas' rolling std is EXACTLY 0.0 on a constant window; the raw-moment
+    # difference above leaves ~eps*scale^2 of roundoff instead, which breaks
+    # the std==0 -> NaN zscore rule at large magnitudes. A full window is
+    # constant iff none of its w-1 consecutive pairs differ — one more O(D)
+    # rolling sum over a difference indicator, exact at any scale. Windows
+    # holding an infinity are excluded: inf == inf pairwise, but pandas'
+    # std of a constant-inf window is NaN (inf - inf), and the raw-moment
+    # path above already propagates that NaN.
+    changed = jnp.concatenate(
+        [jnp.ones_like(filled[..., :1, :]),
+         jnp.where(filled[..., 1:, :] != filled[..., :-1, :], 1.0, 0.0)],
+        axis=_DATE_AXIS)
+    n_changes = rolling_sum(changed, window - 1, axis=_DATE_AXIS)
+    all_finite = rolling_count(jnp.isfinite(x), window,
+                               axis=_DATE_AXIS) == window
+    var = jnp.where(full & all_finite & (n_changes == 0), 0.0, var)
     return mean, var, full
 
 

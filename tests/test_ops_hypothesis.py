@@ -1,0 +1,172 @@
+"""Property-based fuzzing: ops vs the pandas oracle on arbitrary panels.
+
+Hypothesis drives the panel content — tie-heavy half-integer values at three
+magnitude scales, independent NaN masks, ragged universes with ~35% holes,
+and window lengths spanning 1 to beyond-the-panel — and every drawn case is
+checked against the pandas oracle. This is the randomized-ragged-panels leg
+of SURVEY.md §4, beyond the fixed-seed oracle tests.
+
+Shapes are FIXED (D=10, N=6) so kernels trace once per (op, window); only
+data varies across examples.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from factormodeling_tpu import ops
+from tests import pandas_oracle as po
+
+D, N = 10, 6
+WINDOWS = (1, 2, 3, 5, 10, 13)  # incl. window == D and window > D
+
+_SETTINGS = dict(deadline=None, max_examples=25,
+                 suppress_health_check=[HealthCheck.too_slow])
+
+
+@st.composite
+def panels(draw, with_universe=True):
+    """(dense, universe, long_series): half-integer ties, NaNs, holes."""
+    vals = draw(st.lists(st.integers(-4, 4), min_size=D * N, max_size=D * N))
+    scale = draw(st.sampled_from([1.0, 1e6, 1e-6]))
+    x = np.asarray(vals, dtype=np.float64).reshape(D, N) / 2.0 * scale
+    nan_mask = np.asarray(
+        draw(st.lists(st.booleans(), min_size=D * N, max_size=D * N))
+    ).reshape(D, N)
+    x[nan_mask & (np.arange(D * N).reshape(D, N) % 3 > 0)] = np.nan
+    if with_universe:
+        hole = np.asarray(
+            draw(st.lists(st.sampled_from([True, True, False]),
+                          min_size=D * N, max_size=D * N))).reshape(D, N)
+        universe = hole
+    else:
+        universe = np.ones((D, N), dtype=bool)
+    dense = x.copy()
+    dense[~universe] = 777.0  # garbage that must never leak
+    return dense, universe, po.dense_to_long(x, universe), scale
+
+
+def _check(got, oracle_long, universe, scale, atol_units=1e-9):
+    got = np.asarray(got)
+    exp = po.long_to_dense(oracle_long, D, N)
+    exp[~universe] = np.nan
+    np.testing.assert_allclose(got, exp, rtol=1e-7,
+                               atol=atol_units * max(scale, 1.0),
+                               equal_nan=True)
+
+
+@settings(**_SETTINGS)
+@given(case=panels(), w=st.sampled_from(WINDOWS))
+def test_fuzz_ts_ops(case, w):
+    dense, universe, s, scale = case
+    xd, ud = jnp.asarray(dense), jnp.asarray(universe)
+    _check(ops.ts_sum(xd, w, universe=ud), po.o_ts_sum(s, w), universe, scale)
+    _check(ops.ts_mean(xd, w, universe=ud), po.o_ts_mean(s, w), universe, scale)
+    # w == 1 included: ddof=1 with one observation is all-NaN on both sides
+    _check(ops.ts_std(xd, w, universe=ud), po.o_ts_std(s, w), universe,
+           scale)
+    # zscore divides by the window std: half-integer ties make exact-zero
+    # stds common, the oracle maps them to NaN; ratio outputs are O(1)
+    _check(ops.ts_zscore(xd, w, universe=ud), po.o_ts_zscore(s, w),
+           universe, 1.0, atol_units=1e-7)
+    _check(ops.ts_rank(xd, w, universe=ud), po.o_ts_rank(s, w), universe, 1.0)
+    _check(ops.ts_diff(xd, w, universe=ud), po.o_ts_diff(s, w), universe,
+           scale)
+    _check(ops.ts_delay(xd, w, universe=ud), po.o_ts_delay(s, w), universe,
+           scale)
+    _check(ops.ts_decay(xd, w, universe=ud), po.o_ts_decay(s, w), universe,
+           scale)
+    _check(ops.ts_backfill(xd, universe=ud), po.o_ts_backfill(s), universe,
+           scale)
+
+
+@settings(**_SETTINGS)
+@given(case=panels())
+def test_fuzz_cs_ops(case):
+    dense, universe, s, scale = case
+    xd, ud = jnp.asarray(dense), jnp.asarray(universe)
+    _check(ops.cs_rank(xd, universe=ud), po.o_cs_rank(s), universe, 1.0)
+    _check(ops.cs_winsor(xd, universe=ud), po.o_cs_winsor(s), universe, scale)
+    _check(ops.cs_filter_center(xd, universe=ud), po.o_cs_filter_center(s),
+           universe, scale)
+    _check(ops.cs_zscore(xd, universe=ud), po.o_cs_zscore(s), universe, 1.0,
+           atol_units=1e-7)
+    _check(ops.cs_mean(xd, universe=ud), po.o_cs_mean(s), universe, scale)
+    _check(ops.market_neutralize(xd, universe=ud), po.o_market_neutralize(s),
+           universe, 1.0, atol_units=1e-7)
+
+
+@settings(**_SETTINGS)
+@given(case=panels(),
+       grp_vals=st.lists(st.integers(0, 2), min_size=D * N, max_size=D * N))
+def test_fuzz_group_ops(case, grp_vals):
+    dense, universe, s, scale = case
+    groups = np.asarray(grp_vals, dtype=np.int32).reshape(D, N)
+    grp_long = po.dense_to_long(groups.astype(np.float64), universe)
+    # group ops have no universe kwarg: for the *input statistics* an absent
+    # row is equivalent to a NaN value, so callers NaN the out-of-universe
+    # cells going in — but outputs broadcast per-(date, group) stats to every
+    # cell (pandas transform hands NaN rows the group mean too), so callers
+    # must also mask the output (the compat layer's realignment does both)
+    xd = jnp.asarray(np.where(universe, dense, np.nan))
+    gd = jnp.asarray(groups)
+
+    def masked(out):
+        return jnp.where(jnp.asarray(universe), out, jnp.nan)
+
+    _check(masked(ops.group_mean(xd, gd, 3)),
+           po.o_group_mean(s, grp_long), universe, scale)
+    _check(masked(ops.group_neutralize(xd, gd, 3)),
+           po.o_group_neutralize(s, grp_long), universe, scale)
+    _check(masked(ops.group_normalize(xd, gd, 3)),
+           po.o_group_normalize(s, grp_long), universe, 1.0, atol_units=1e-7)
+    _check(masked(ops.group_rank_normalized(xd, gd, 3)),
+           po.o_group_rank_normalized(s, grp_long), universe, 1.0)
+
+
+@settings(**_SETTINGS)
+@given(ycase=panels(), xvals=st.lists(st.integers(-4, 4), min_size=D * N,
+                                      max_size=D * N))
+def test_fuzz_cs_regression(ycase, xvals):
+    dense_y, universe, ys, scale = ycase
+    x = np.asarray(xvals, dtype=np.float64).reshape(D, N) / 2.0
+    xs = po.dense_to_long(x, universe)
+    yd, ud = jnp.asarray(dense_y), jnp.asarray(universe)
+    xd = jnp.asarray(np.where(universe, x, 777.0))
+    for rettype in ("resid", "beta", "alpha", "fitted", "r2"):
+        got = ops.cs_regression(yd, xd, rettype=rettype, universe=ud)
+        # slopes/r2 are ratio-valued; resid/alpha/fitted scale with y
+        unit_scaled = rettype in ("resid", "alpha", "fitted")
+        _check(got, po.o_cs_regression(ys, xs, rettype=rettype), universe,
+               scale if unit_scaled else 1.0, atol_units=1e-6)
+
+
+@settings(**_SETTINGS)
+@given(ycase=panels(), xvals=st.lists(st.integers(-4, 4), min_size=D * N,
+                                      max_size=D * N),
+       w=st.sampled_from((2, 3, 5)), rettype=st.sampled_from((0, 1, 2, 3, 6)))
+def test_fuzz_ts_regression(ycase, xvals, w, rettype):
+    dense_y, universe, ys, scale = ycase
+    x = np.asarray(xvals, dtype=np.float64).reshape(D, N) / 2.0
+    xs = po.dense_to_long(x, universe)
+    yd, ud = jnp.asarray(dense_y), jnp.asarray(universe)
+    xd = jnp.asarray(np.where(universe, x, 777.0))
+    got = np.asarray(ops.ts_regression_fast(yd, xd, w, rettype=rettype,
+                                            universe=ud))
+    exp = po.long_to_dense(po.o_ts_regression(ys, xs, w, rettype=rettype),
+                           D, N)
+    exp[~universe] = np.nan
+    # half-integer draws make exactly-degenerate windows (constant x -> var 0)
+    # common; 0/0-vs-c/0 conventions there are pinned by the deterministic
+    # tests, so the fuzz compares only well-posed windows on both sides
+    well_posed = np.isfinite(exp) | np.isnan(dense_y) | ~universe
+    got = np.where(well_posed, got, np.nan)
+    exp = np.where(well_posed, exp, np.nan)
+    finite = np.isfinite(exp)
+    unit_scaled = rettype in (0, 1, 3)
+    np.testing.assert_allclose(
+        got[finite], exp[finite], rtol=1e-6,
+        atol=1e-6 * (max(scale, 1.0) if unit_scaled else 1.0))
+    # NaN cells must agree exactly (no value invented where pandas has none)
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(exp))

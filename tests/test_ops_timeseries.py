@@ -125,3 +125,30 @@ def test_pallas_dispatch_is_tpu_only():
     from factormodeling_tpu.ops import _pallas_window as pw
 
     assert not pw.pallas_available()
+
+
+def test_ts_std_constant_window_exact_zero():
+    """Pandas' rolling std is EXACTLY 0.0 on constant windows at any
+    magnitude (raw-moment roundoff must not leak through), zscore maps the
+    zero std to NaN, and constant-infinity windows stay NaN (inf - inf)."""
+    import pandas as pd
+
+    for scale in (1.0, 1e6, 1e-6):
+        x = np.full((8, 2), 1.5 * scale)
+        x[0, 1] = 2.0 * scale  # column 1 is non-constant in the first window
+        std = np.asarray(ops.ts_std(jnp.array(x), 3))
+        z = np.asarray(ops.ts_zscore(jnp.array(x), 3))
+        assert (std[2:, 0] == 0.0).all(), f"std not exactly 0 at {scale}"
+        assert np.isnan(z[2:, 0]).all(), f"zscore not NaN at {scale}"
+        exp = pd.DataFrame(x).rolling(3, min_periods=3).std().to_numpy()
+        np.testing.assert_allclose(std, exp, rtol=1e-6, equal_nan=True)
+    # near-constant variance survives (not swallowed by the constant check)
+    x = np.cumsum(np.full((8, 1), 1e-4), axis=0) + 1000.0
+    std = np.asarray(ops.ts_std(jnp.array(x), 3))
+    assert (std[2:, 0] > 0).all()
+    # all-inf window: pandas gives NaN (inf - inf), so do we
+    x = np.full((6, 1), np.inf)
+    std = np.asarray(ops.ts_std(jnp.array(x), 2))
+    assert np.isnan(std[1:]).all()
+    z1 = np.asarray(ops.ts_std(jnp.array(np.ones((5, 1))), 1))
+    assert np.isnan(z1).all()  # ddof=1 with one observation, pandas parity

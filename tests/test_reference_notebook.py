@@ -16,7 +16,9 @@ import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-NOTEBOOK = Path("/root/reference/pipeline.ipynb")
+from examples.run_reference_notebook import DEFAULT_NOTEBOOK  # noqa: E402
+
+NOTEBOOK = Path(DEFAULT_NOTEBOOK)
 
 pytestmark = pytest.mark.skipif(
     not NOTEBOOK.exists(), reason="reference notebook not available")
