@@ -76,8 +76,22 @@ def _soft(a, k):
 
 
 _ADAPT_EVERY = 25          # iterations per segment between rho updates
+_UNROLL = 25               # TPU inner-loop unroll factor (see _unroll_factor)
 _RHO_STEP_CLIP = 5.0       # max per-update rho movement factor
 _RHO_BOUNDS = (1e-4, 1e7)  # global rho clamp (scaled problem units)
+
+
+def _unroll_factor() -> int:
+    """Inner-loop unroll, decided at trace time like the Pallas dispatch.
+
+    The iteration body is a handful of latency-bound small matvecs; on TPU
+    the XLA while-loop's per-step overhead dominates the solve, and fully
+    unrolling the 25-iteration segments halves the mvo_turnover headline
+    (1.31 s -> 0.52 s at 1332x1000). XLA's *CPU* pipeline, however, has been
+    observed to segfault compiling the fully-unrolled body, so every other
+    backend keeps the rolled loop.
+    """
+    return _UNROLL if jax.default_backend() == "tpu" else 1
 
 
 def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
@@ -110,11 +124,11 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
         moved = prob.center + _soft(v - prob.center, l1 / rho)
         return jnp.clip(moved, prob.lo, prob.hi)
 
-    def segment(k, carry):
+    def segment(carry, seg_len, unroll):
+        # seg_len: number of body iterations this segment (static on the
+        # unrolled path, traced on the rolled path — both sum to `iters`).
         x, z, u, rho = carry
         fac = factor(rho)
-        # last segment runs the remainder so the total is exactly `iters`
-        seg_len = jnp.minimum(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
 
         def body(_, st):
             x, z, u, _ = st
@@ -126,7 +140,7 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
             return x, z_new, u, dz
 
         x, z, u, dz = lax.fori_loop(
-            0, seg_len, body, (x, z, u, jnp.zeros((), dtype)))
+            0, seg_len, body, (x, z, u, jnp.zeros((), dtype)), unroll=unroll)
 
         # residual balancing: r_prim = ||x - z||_inf, r_dual = rho ||dz||_inf;
         # move rho by sqrt(ratio), clipped, and rescale the scaled dual u
@@ -144,8 +158,28 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
     z0 = jnp.clip(jnp.zeros(n, dtype), prob.lo, prob.hi)
     u0 = jnp.zeros(n, dtype)
     rho = jnp.asarray(rho0, dtype)
-    n_seg = -(-int(iters) // _ADAPT_EVERY)           # ceil: total == iters
-    x, z, u, rho = lax.fori_loop(0, max(n_seg, 1), segment, (z0, z0, u0, rho))
+    carry = (z0, z0, u0, rho)
+    unroll = _unroll_factor()
+    iters = int(iters)
+    if unroll > 1:
+        # TPU: Python-level segment schedule -> static bounds -> unrolled
+        # bodies (each segment traces separately; segment counts are small).
+        # iters=0 still runs one zero-length segment (its rho balancing sees
+        # the untouched iterates), exactly like the rolled path below.
+        schedule = ([min(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
+                     for k in range(-(-iters // _ADAPT_EVERY))] or [0])
+        for seg_len in schedule:
+            carry = segment(carry, seg_len, max(min(seg_len, unroll), 1))
+    else:
+        # rolled path: one traced segment body inside a fori_loop (cheapest
+        # to compile; the last segment runs the remainder so totals match)
+        def seg_k(k, c):
+            seg_len = jnp.minimum(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
+            return segment(c, seg_len, 1)
+
+        n_seg = max(-(-iters // _ADAPT_EVERY), 1)    # ceil: total == iters
+        carry = lax.fori_loop(0, n_seg, seg_k, carry)
+    x, z, u, rho = carry
     x = x_step(factor(rho), z, u, rho)  # final equality-exact polish
     return ADMMResult(x=x, z=z, primal_residual=jnp.max(jnp.abs(x - z)))
 
