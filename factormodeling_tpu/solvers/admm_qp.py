@@ -86,8 +86,8 @@ def _unroll_factor() -> int:
 
     The iteration body is a handful of latency-bound small matvecs; on TPU
     the XLA while-loop's per-step overhead dominates the solve, and fully
-    unrolling the 25-iteration segments halves the mvo_turnover headline
-    (1.31 s -> 0.52 s at 1332x1000). XLA's *CPU* pipeline, however, has been
+    unrolling the 25-iteration segments cuts the mvo_turnover headline
+    from 1.31 s to 0.48 s at 1332x1000. XLA's *CPU* pipeline, however, has been
     observed to segfault compiling the fully-unrolled body, so every other
     backend keeps the rolled loop.
     """
@@ -139,8 +139,11 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
             dz = jnp.max(jnp.abs(z_new - z))         # for the dual residual
             return x, z_new, u, dz
 
+        # omit unroll on the rolled path: seg_len is traced there, and some
+        # jax releases reject any explicit unroll with dynamic loop bounds
         x, z, u, dz = lax.fori_loop(
-            0, seg_len, body, (x, z, u, jnp.zeros((), dtype)), unroll=unroll)
+            0, seg_len, body, (x, z, u, jnp.zeros((), dtype)),
+            unroll=unroll if unroll != 1 else None)
 
         # residual balancing: r_prim = ||x - z||_inf, r_dual = rho ||dz||_inf;
         # move rho by sqrt(ratio), clipped, and rescale the scaled dual u
