@@ -60,7 +60,8 @@ def _masked_pearson(a: jnp.ndarray, b: jnp.ndarray, valid: jnp.ndarray) -> jnp.n
 def daily_factor_stats(factors: jnp.ndarray, returns: jnp.ndarray,
                        *, shift_periods: int = 1,
                        universe: jnp.ndarray | None = None,
-                       min_pairs: int = 3):
+                       min_pairs: int = 3,
+                       stats: tuple = ("ic", "rank_ic", "factor_return")):
     """Per-(factor, date) IC / rank-IC / factor-return over a dense stack.
 
     Args:
@@ -71,12 +72,23 @@ def daily_factor_stats(factors: jnp.ndarray, returns: jnp.ndarray,
         selector shifts once more at init, see ``factor_selector.py:84``).
       universe: optional ``bool[D, N]`` membership mask (shift hops gaps).
       min_pairs: dates with fewer valid pairs are NaN (reference skips < 3).
+      stats: which stats to compute. ``rank_ic`` costs one ``lax.sort`` of
+        the whole stack — the dominant cost of this function at scale
+        (~3x the rest combined at 5040x5000) — so callers whose selector
+        consumes only ``factor_return`` (e.g. momentum) should drop it;
+        requested-but-unreturned stats cannot be dead-code-eliminated once
+        they are jit outputs.
 
     Returns:
-      dict with ``ic``, ``rank_ic``, ``factor_return`` (each ``float[F, D]``)
-      and ``n_pairs`` (``int[F, D]``). ``factor_return`` is NaN where the
-      no-intercept denominator ``f.f`` is 0 or the date is skipped.
+      dict with the requested subset of ``ic``, ``rank_ic``,
+      ``factor_return`` (each ``float[F, D]``) and always ``n_pairs``
+      (``int[F, D]``). ``factor_return`` is NaN where the no-intercept
+      denominator ``f.f`` is 0 or the date is skipped.
     """
+    unknown = set(stats) - {"ic", "rank_ic", "factor_return"}
+    if unknown:
+        raise ValueError(f"unknown stats {sorted(unknown)}; valid: "
+                         "'ic', 'rank_ic', 'factor_return'")
     if shift_periods:
         if universe is not None:
             f = masked_shift(factors, universe, shift_periods, axis=_DATE_AXIS)
@@ -93,28 +105,27 @@ def daily_factor_stats(factors: jnp.ndarray, returns: jnp.ndarray,
     cnt = valid.sum(axis=_ASSET_AXIS)
     enough = cnt >= min_pairs
 
-    ic = _masked_pearson(f, r, valid)
-    # rank-IC in sorted space: Pearson is permutation-invariant, so carry r
-    # through the rank sort as a payload operand — no second sort to
-    # un-permute the ranks, no gather (both lower poorly on TPU; the one
-    # sort dominates this whole function's cost)
-    franks_sorted, valid_sorted, (r_sorted,) = rank_sorted(
-        f, axis=_ASSET_AXIS, carry=(r,))
-    rank_ic = _masked_pearson(franks_sorted, r_sorted, valid_sorted)
-
-    f0 = jnp.where(valid, f, 0.0)
-    r0 = jnp.where(valid, r, 0.0)
-    num = (f0 * r0).sum(axis=_ASSET_AXIS)
-    den = (f0 * f0).sum(axis=_ASSET_AXIS)
-    beta = jnp.where(den > 0, num / den, jnp.nan)
-
     nan = jnp.nan
-    return dict(
-        ic=jnp.where(enough, ic, nan),
-        rank_ic=jnp.where(enough, rank_ic, nan),
-        factor_return=jnp.where(enough, beta, nan),
-        n_pairs=cnt,
-    )
+    out = dict(n_pairs=cnt)
+    if "ic" in stats:
+        out["ic"] = jnp.where(enough, _masked_pearson(f, r, valid), nan)
+    if "rank_ic" in stats:
+        # rank-IC in sorted space: Pearson is permutation-invariant, so carry
+        # r through the rank sort as a payload operand — no second sort to
+        # un-permute the ranks, no gather (both lower poorly on TPU; the one
+        # sort dominates this whole function's cost)
+        franks_sorted, valid_sorted, (r_sorted,) = rank_sorted(
+            f, axis=_ASSET_AXIS, carry=(r,))
+        rank_ic = _masked_pearson(franks_sorted, r_sorted, valid_sorted)
+        out["rank_ic"] = jnp.where(enough, rank_ic, nan)
+    if "factor_return" in stats:
+        f0 = jnp.where(valid, f, 0.0)
+        r0 = jnp.where(valid, r, 0.0)
+        num = (f0 * r0).sum(axis=_ASSET_AXIS)
+        den = (f0 * f0).sum(axis=_ASSET_AXIS)
+        beta = jnp.where(den > 0, num / den, jnp.nan)
+        out["factor_return"] = jnp.where(enough, beta, nan)
+    return out
 
 
 def _t_sf_two_sided(t: jnp.ndarray, df: jnp.ndarray) -> jnp.ndarray:
@@ -197,24 +208,26 @@ def rolling_metrics(daily: dict, window: int) -> dict:
         var = jnp.maximum(s2 - s * mean, 0.0) / jnp.where(n > 1, n - 1.0, jnp.nan)
         return mean, jnp.sqrt(var), n
 
-    ic_mean, ic_std, _ = win_mean_std(daily["ic"])
-    ric_mean, ric_std, _ = win_mean_std(daily["rank_ic"])
-    b_mean, b_std, b_n = win_mean_std(daily["factor_return"])
-
-    tstat = b_mean / (b_std / jnp.sqrt(b_n))
-    pval = jnp.where(b_n > 1, _t_sf_two_sided(tstat, b_n - 1.0), jnp.nan)
-    tstat = jnp.where(b_n > 1, tstat, jnp.nan)
-
-    pos = jnp.where(jnp.isnan(daily["factor_return"]), 0.0,
-                    (daily["factor_return"] > 0).astype(b_mean.dtype))
-    pct_pos = rolling_sum(pos, window, axis=-1) / jnp.where(b_n > 0, b_n, jnp.nan)
-
-    return {
-        "IC": ic_mean,
-        "IC_IR": ic_mean / ic_std,
-        "rank_IC": ric_mean,
-        "rank_IC_IR": ric_mean / ric_std,
-        "factor_return_tstat": tstat,
-        "factor_return_pvalue": pval,
-        "pct_pos_factor_return": pct_pos,
-    }
+    out = {}
+    # each group is derived only from its own daily stat, so a partial
+    # `daily` (daily_factor_stats(..., stats=...)) yields a partial table
+    if "ic" in daily:
+        ic_mean, ic_std, _ = win_mean_std(daily["ic"])
+        out["IC"] = ic_mean
+        out["IC_IR"] = ic_mean / ic_std
+    if "rank_ic" in daily:
+        ric_mean, ric_std, _ = win_mean_std(daily["rank_ic"])
+        out["rank_IC"] = ric_mean
+        out["rank_IC_IR"] = ric_mean / ric_std
+    if "factor_return" in daily:
+        b_mean, b_std, b_n = win_mean_std(daily["factor_return"])
+        tstat = b_mean / (b_std / jnp.sqrt(b_n))
+        pval = jnp.where(b_n > 1, _t_sf_two_sided(tstat, b_n - 1.0), jnp.nan)
+        out["factor_return_tstat"] = jnp.where(b_n > 1, tstat, jnp.nan)
+        out["factor_return_pvalue"] = pval
+        pos = jnp.where(jnp.isnan(daily["factor_return"]), 0.0,
+                        (daily["factor_return"] > 0).astype(b_mean.dtype))
+        out["pct_pos_factor_return"] = (
+            rolling_sum(pos, window, axis=-1)
+            / jnp.where(b_n > 0, b_n, jnp.nan))
+    return out

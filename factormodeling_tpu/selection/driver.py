@@ -25,15 +25,35 @@ from factormodeling_tpu.ops._window import rolling_sum, shift
 from factormodeling_tpu.selection.selectors import (
     FACTOR_SELECTION_METHODS,
     SelectionContext,
+    factor_momentum_selector,
+    icir_top_selector,
+    mvo_selector,
+    pca_selector,
+    regression_selector,
 )
 
 __all__ = ["rolling_selection", "build_selection_context"]
+
+#: daily stats each built-in selector actually reads (see the selector
+#: bodies in selectors.py): icir_top reads rank_IC_IR / IC_IR; momentum,
+#: mvo, pca, and regression consume only the precomputed factor returns.
+#: Keyed by FUNCTION IDENTITY, not method name, so a custom selector
+#: registered over a built-in name still gets the full table.
+_METRIC_NEEDS = {
+    icir_top_selector: ("ic", "rank_ic"),
+    factor_momentum_selector: (),
+    mvo_selector: (),
+    pca_selector: (),
+    regression_selector: (),
+}
 
 
 def build_selection_context(factors: jnp.ndarray, returns: jnp.ndarray,
                             factor_ret: jnp.ndarray, window: int,
                             *, universe: jnp.ndarray | None = None,
-                            shift_periods: int = 2) -> SelectionContext:
+                            shift_periods: int = 2,
+                            stats: tuple = ("ic", "rank_ic",
+                                            "factor_return")) -> SelectionContext:
     """Precompute the whole-sample tensors selectors consume.
 
     Args:
@@ -44,9 +64,19 @@ def build_selection_context(factors: jnp.ndarray, returns: jnp.ndarray,
       window: trailing lookback length.
       shift_periods: total exposure lag in the metrics; the reference's
         selection path shifts twice (init + metrics), hence the default 2.
+      stats: daily stats to compute for the metrics table. The reference
+        recomputes the full table every day whether or not the selector
+        reads it; skipping stats a selector never consumes is
+        observationally equivalent and drops the rank sort — the dominant
+        cost at scale (see :func:`daily_factor_stats`).
     """
+    if not stats:
+        # nothing in the metrics table is consumed: skip the exposure-stack
+        # traversal entirely (eager callers get no XLA DCE to save them)
+        metrics_win = {}
+        return _finish_context(metrics_win, factor_ret, window)
     daily = daily_factor_stats(factors, returns, shift_periods=shift_periods,
-                               universe=universe)
+                               universe=universe, stats=stats)
     # The reference applies its second exposure shift INSIDE the window slice
     # (factor_selector.py:84 then :33), so the slice's first date has all-NaN
     # exposures and contributes no pairs: a window of W dates aggregates only
@@ -59,7 +89,11 @@ def build_selection_context(factors: jnp.ndarray, returns: jnp.ndarray,
     rm = rolling_metrics(daily, max(window - 1, 1))
     # selectors for date i read the window ending at i-1 (today excluded)
     metrics_win = {k: shift(v, 1, axis=-1) for k, v in rm.items()}
+    return _finish_context(metrics_win, factor_ret, window)
 
+
+def _finish_context(metrics_win: dict, factor_ret: jnp.ndarray,
+                    window: int) -> SelectionContext:
     ok = ~jnp.isnan(factor_ret)
     sums = rolling_sum(jnp.where(ok, factor_ret, 0.0), window, axis=0)
     return SelectionContext(
@@ -85,8 +119,13 @@ def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
         # processed (also keeps the covariance selectors' window-sized
         # dynamic slices in range)
         return jnp.zeros(factor_ret.shape, factor_ret.dtype)
+    # built-in selectors that never read the metrics table skip its daily
+    # stats (and with them the rank sort); custom registry entries get the
+    # full table — their consumption is unknown
+    needs = _METRIC_NEEDS.get(selector, ("ic", "rank_ic", "factor_return"))
     ctx = build_selection_context(factors, returns, factor_ret, window,
-                                  universe=universe, shift_periods=shift_periods)
+                                  universe=universe, shift_periods=shift_periods,
+                                  stats=needs)
     raw = selector(ctx, **(method_kwargs or {}))  # [D, F]
 
     d = factor_ret.shape[0]
