@@ -540,16 +540,21 @@ def bench_north_star(smoke=False, profile=False):
     composite, equal-scheme backtest — on one chip, target < 60 s.
 
     The full factor stack (20 GB f32) exceeds single-chip HBM, so factors
-    stream through in chunks regenerated on device from the same PRNG keys:
-    pass 1 accumulates per-factor daily stats, pass 2 the weighted composite.
+    stream through the library's out-of-core API
+    (``parallel/streaming.py``) in chunks regenerated on device from the
+    same PRNG keys: pass 1 the per-factor daily stats, pass 2 the weighted
+    composite.
     """
     import jax
     import jax.numpy as jnp
 
-    from factormodeling_tpu import ops
     from factormodeling_tpu.backtest import SimulationSettings, run_simulation
-    from factormodeling_tpu.metrics import daily_factor_stats, rolling_metrics
     from factormodeling_tpu.ops._window import rolling_sum, shift
+    from factormodeling_tpu.parallel import (
+        chunk_slices,
+        streamed_factor_stats,
+        streamed_weighted_composite,
+    )
 
     if smoke:
         f, d, n, chunk, window = 8, 64, 48, 4, 8
@@ -562,22 +567,10 @@ def bench_north_star(smoke=False, profile=False):
     rets = jnp.asarray(rets_np)
     cap = jnp.asarray(rng.integers(1, 4, size=(d, n)).astype(np.float32))
 
-    def gen_chunk(seed):
+    def gen_chunk(seed):  # device source: fused into the per-chunk kernels
         key = jax.random.key(seed)
         return 0.02 * rets[None] + jax.random.normal(
             key, (chunk, d, n), dtype=jnp.float32)
-
-    @jax.jit
-    def stats_chunk(seed):
-        fac = gen_chunk(seed)
-        s = daily_factor_stats(fac, rets, shift_periods=2)
-        return s["rank_ic"], s["factor_return"]
-
-    @jax.jit
-    def composite_chunk(seed, weights_chunk):
-        fac = gen_chunk(seed)
-        z = ops.cs_zscore(fac)
-        return jnp.einsum("fd,fdn->dn", weights_chunk, jnp.nan_to_num(z))
 
     @jax.jit
     def momentum_weights(factor_ret):
@@ -601,16 +594,18 @@ def bench_north_star(smoke=False, profile=False):
     n_chunks = f // chunk
 
     def full_pipeline():
-        fr_parts = []
-        for ci in range(n_chunks):
-            _, frc = stats_chunk(ci)
-            fr_parts.append(frc.T)          # [D, chunk]
-        factor_ret = jnp.concatenate(fr_parts, axis=1)   # [D, F]
+        # rank-IC is part of full scoring (the reference's metrics table
+        # computes it regardless of the selector) — charged honestly here
+        daily = streamed_factor_stats(gen_chunk, n_chunks, rets,
+                                      shift_periods=2,
+                                      stats=("rank_ic", "factor_return"),
+                                      fuse_source=True)
+        factor_ret = daily["factor_return"].T            # [D, F]
         weights = momentum_weights(factor_ret)           # [D, F]
-        comp = jnp.zeros((d, n), jnp.float32)
-        for ci in range(n_chunks):
-            wc = weights[:, ci * chunk:(ci + 1) * chunk].T  # [chunk, D]
-            comp = comp + composite_chunk(ci, wc)
+        wt = weights.T                                   # [F, D]
+        comp = streamed_weighted_composite(
+            gen_chunk, [wt[s] for s in chunk_slices(f, chunk)],
+            transform="zscore", fuse_source=True)
         out = backtest(comp)
         _fence(out.result.log_return)
         return weights, comp, out

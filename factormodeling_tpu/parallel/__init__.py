@@ -35,6 +35,13 @@ from factormodeling_tpu.parallel.pipeline import (  # noqa: F401
     make_sharded_research_step,
     result_summary,
 )
+from factormodeling_tpu.parallel.streaming import (  # noqa: F401
+    chunk_slices,
+    clear_streaming_cache,
+    host_array_source,
+    streamed_factor_stats,
+    streamed_weighted_composite,
+)
 from factormodeling_tpu.parallel.sweep import (  # noqa: F401
     SweepOutput,
     combo_weight_matrix,

@@ -1,0 +1,211 @@
+"""Out-of-core factor streaming: score and blend factor stacks larger than
+one chip's HBM by chunking the factor axis.
+
+At the north-star scale (200 factors x 5040 dates x 5000 assets, f32) the
+stack is ~20 GB — beyond a single chip. Dates and assets are needed whole
+(rolling windows / cross-sections), but factors are embarrassingly parallel,
+so SURVEY.md §7's fallback is to stream factor chunks through the chip:
+
+  pass 1  per-chunk :func:`~factormodeling_tpu.metrics.daily_factor_stats`
+          -> concat along F -> any [D, F]-consuming selection
+  pass 2  per-chunk normalize + weighted contraction, accumulated into the
+          composite signal [D, N]
+
+Chunks come from a *chunk source*: any callable ``source(i) -> float[C_i,
+D, N]``. Two kinds:
+
+- **host sources** (default, ``fuse_source=False``): the source returns a
+  concrete array — loaded from disk, sliced from a host stack
+  (:func:`host_array_source`), fetched over the network. It runs outside
+  the per-chunk jit; its output is device_put and handed to the kernel.
+- **device sources** (``fuse_source=True``): the source is *traceable
+  JAX code* that computes the chunk on device (e.g. regenerating factors
+  from PRNG keys, slicing a device-resident array with ``dynamic_slice``).
+  It is called INSIDE the per-chunk jit with a TRACED chunk index — one
+  compilation serves every chunk, so all chunks must share one shape —
+  and the chunk is produced and consumed in one kernel, never existing as
+  a standalone buffer between dispatches. On relay-attached backends this
+  matters enormously: materializing a GB-scale chunk between two jits
+  costs a round trip per chunk (measured 8.5 s -> ~90 s on the
+  north-star bench).
+
+Each pass is one jit per chunk shape — chunks of equal size share a
+single compilation.
+
+``bench.py``'s north-star config runs on exactly these entry points; the
+multi-chip analog shards the factor axis over a mesh instead
+(``parallel/pipeline.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from factormodeling_tpu import ops
+from factormodeling_tpu.metrics import daily_factor_stats
+
+__all__ = ["chunk_slices", "clear_streaming_cache", "host_array_source",
+           "streamed_factor_stats", "streamed_weighted_composite"]
+
+# The per-chunk jits are cached on (source, config), NOT rebuilt per call —
+# a fresh jax.jit wrapper per invocation would recompile every kernel on
+# every pipeline run (jit caches by function identity; measured: the
+# north-star's timed pass went 8.6 s -> 195 s when these were per-call
+# lambdas, all of it remote compilation). Arrays (returns/universe/weights)
+# enter as traced arguments so one cached kernel serves every call.
+#
+#
+# Lifetime note: a cached fused kernel strongly references its source
+# callable (the jit closure), and with it whatever the source captured —
+# often GB-scale device buffers. Weak keying cannot help (the value's
+# closure roots the key), so the cache is BOUNDED (LRU, oldest source
+# evicted) and :func:`clear_streaming_cache` releases everything on demand.
+_KERNEL_CACHE_SIZE = 16
+_kernel_cache: "dict[tuple, object]" = {}
+
+
+def clear_streaming_cache() -> None:
+    """Drop every cached per-chunk kernel (and the source closures — with
+    their captured device buffers — that the kernels pin)."""
+    _kernel_cache.clear()
+
+
+def _cached_kernel(source, config, build):
+    """jit for (source, config), LRU-bounded; ``source`` (None for the host
+    path) participates in the key by identity."""
+    key = (source, config)
+    fn = _kernel_cache.get(key)
+    if fn is None:
+        fn = build()
+        _kernel_cache[key] = fn
+        while len(_kernel_cache) > _KERNEL_CACHE_SIZE:
+            _kernel_cache.pop(next(iter(_kernel_cache)))
+    return fn
+
+
+def chunk_slices(n_factors: int, chunk: int) -> list[slice]:
+    """Contiguous factor-axis slices of width ``chunk`` (last may be short)."""
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    return [slice(i, min(i + chunk, n_factors))
+            for i in range(0, n_factors, chunk)]
+
+
+def host_array_source(stack, chunk: int):
+    """(source, slices) for a host-resident ``float[F, D, N]`` stack; each
+    call device-puts one chunk."""
+    slices = chunk_slices(stack.shape[0], chunk)
+    return (lambda i: jnp.asarray(stack[slices[i]])), slices
+
+
+def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
+                          n_chunks: int, returns: jnp.ndarray, *,
+                          shift_periods: int = 1,
+                          universe: jnp.ndarray | None = None,
+                          stats: tuple = ("ic", "rank_ic", "factor_return"),
+                          fuse_source: bool = False) -> dict:
+    """Pass 1: per-(factor, date) stats for a streamed stack.
+
+    Returns the :func:`daily_factor_stats` dict with every array
+    ``[F_total, D]``, factors ordered by chunk index. Device memory high-water
+    is one chunk plus its stats temporaries. ``fuse_source=True`` traces the
+    source into the per-chunk kernel (device sources — see module docs).
+    """
+    if n_chunks <= 0:
+        raise ValueError(f"n_chunks must be positive, got {n_chunks}")
+
+    one = _stats_kernel(source if fuse_source else None, shift_periods,
+                        tuple(stats))
+    if fuse_source:
+        parts = [one(i, returns, universe) for i in range(n_chunks)]
+    else:
+        parts = [one(source(i), returns, universe) for i in range(n_chunks)]
+    return {k: jnp.concatenate([p[k] for p in parts], axis=0)
+            for k in parts[0]}
+
+
+def _stats_kernel(fused_source, shift_periods: int, stats: tuple):
+    """One cached jit per (source, config); first arg is the chunk (host
+    path, ``fused_source=None``) or the traced chunk index (device path)."""
+
+    def build():
+        def kernel(fac, returns, universe):
+            return daily_factor_stats(fac, returns,
+                                      shift_periods=shift_periods,
+                                      universe=universe, stats=stats)
+
+        if fused_source is None:
+            return jax.jit(kernel)
+        return jax.jit(lambda i, returns, universe:
+                       kernel(fused_source(i), returns, universe))
+
+    return _cached_kernel(fused_source, ("stats", shift_periods, stats),
+                          build)
+
+
+def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
+                                chunk_weights: Sequence[jnp.ndarray],
+                                *, transform: Callable | str = "zscore",
+                                universe: jnp.ndarray | None = None,
+                                fuse_source: bool = False) -> jnp.ndarray:
+    """Pass 2: ``sum_f w[f, d] * transform(stack)[f, d, n]`` streamed.
+
+    Args:
+      source: ``source(i) -> float[C_i, D, N]`` chunk loader (same order as
+        pass 1).
+      chunk_weights: per-chunk ``float[C_i, D]`` weight blocks — e.g.
+        ``weights_df.T`` split with :func:`chunk_slices`. NaN cells of the
+        transformed chunk contribute 0, matching the dense blend's
+        ``nan_to_num`` combine.
+      transform: per-chunk normalization before the contraction: "zscore"
+        (per-date cross-sectional, the reference blend's default), "rank"
+        ([0, 1] cross-sectional rank), "none", or any callable
+        ``float[C, D, N] -> float[C, D, N]``.
+      fuse_source: trace the source into the per-chunk kernel (device
+        sources — see module docs).
+
+    Returns the composite ``float[D, N]``.
+    """
+    if isinstance(transform, str) and transform not in ("zscore", "rank",
+                                                        "none"):
+        raise ValueError(f"unknown transform {transform!r}; valid: "
+                         "'zscore', 'rank', 'none', or a callable")
+
+    one = _composite_kernel(source if fuse_source else None, transform)
+    total = None
+    for i, w in enumerate(chunk_weights):
+        arg0 = i if fuse_source else source(i)
+        part = one(arg0, jnp.asarray(w), universe)
+        total = part if total is None else total + part
+    if total is None:
+        raise ValueError("chunk_weights is empty")
+    return total
+
+
+def _composite_kernel(fused_source, transform):
+    """One cached jit per (source, transform); first arg is the chunk (host
+    path, ``fused_source=None``) or the traced chunk index (device path)."""
+
+    def build():
+        def apply(fac, universe):
+            if transform == "zscore":
+                return ops.cs_zscore(fac, universe=universe)
+            if transform == "rank":
+                return ops.cs_rank(fac, universe=universe)
+            if transform == "none":
+                return fac
+            return transform(fac)
+
+        def kernel(fac, w, universe):
+            return jnp.einsum("fd,fdn->dn", w,
+                              jnp.nan_to_num(apply(fac, universe)))
+
+        if fused_source is None:
+            return jax.jit(kernel)
+        return jax.jit(lambda i, w, universe:
+                       kernel(fused_source(i), w, universe))
+
+    return _cached_kernel(fused_source, ("composite", transform), build)

@@ -1,0 +1,151 @@
+"""Out-of-core factor streaming (parallel/streaming.py): chunked passes must
+match the one-shot dense computation exactly, for any chunking."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu import ops
+from factormodeling_tpu.metrics import daily_factor_stats
+from factormodeling_tpu.parallel import (
+    chunk_slices,
+    host_array_source,
+    streamed_factor_stats,
+    streamed_weighted_composite,
+)
+
+F, D, N = 11, 40, 24  # F deliberately not divisible by the chunk sizes
+
+
+@pytest.fixture
+def panel(rng):
+    stack = rng.normal(size=(F, D, N)).astype(np.float32)
+    stack[rng.uniform(size=stack.shape) < 0.05] = np.nan
+    returns = rng.normal(scale=0.02, size=(D, N)).astype(np.float32)
+    universe = rng.uniform(size=(D, N)) > 0.2
+    return stack, returns, universe
+
+
+def test_chunk_slices_cover_exactly():
+    slices = chunk_slices(F, 4)
+    idx = np.concatenate([np.arange(F)[s] for s in slices])
+    np.testing.assert_array_equal(idx, np.arange(F))
+    with pytest.raises(ValueError):
+        chunk_slices(F, 0)
+
+
+@pytest.mark.parametrize("chunk", [1, 4, F])
+def test_streamed_stats_match_oneshot(panel, chunk):
+    stack, returns, universe = panel
+    dense = daily_factor_stats(jnp.asarray(stack), jnp.asarray(returns),
+                               shift_periods=2,
+                               universe=jnp.asarray(universe))
+    source, slices = host_array_source(stack, chunk)
+    streamed = streamed_factor_stats(source, len(slices),
+                                     jnp.asarray(returns), shift_periods=2,
+                                     universe=jnp.asarray(universe))
+    assert set(streamed) == set(dense)
+    for k in dense:
+        # jit-vs-eager fusion changes f32 reduction order by ~1 ulp
+        np.testing.assert_allclose(np.asarray(streamed[k]),
+                                   np.asarray(dense[k]), rtol=3e-6,
+                                   atol=1e-6, equal_nan=True, err_msg=k)
+
+
+@pytest.mark.parametrize("transform", ["zscore", "rank", "none"])
+def test_streamed_composite_matches_oneshot(panel, transform):
+    stack, returns, universe = panel
+    rng = np.random.default_rng(3)
+    weights = rng.uniform(size=(F, D)).astype(np.float32)
+
+    tf = {"zscore": lambda x: ops.cs_zscore(x, universe=jnp.asarray(universe)),
+          "rank": lambda x: ops.cs_rank(x, universe=jnp.asarray(universe)),
+          "none": lambda x: x}[transform]
+    dense = jnp.einsum("fd,fdn->dn", jnp.asarray(weights),
+                       jnp.nan_to_num(tf(jnp.asarray(stack))))
+
+    source, slices = host_array_source(stack, 4)
+    streamed = streamed_weighted_composite(
+        source, [weights[s] for s in slices], transform=transform,
+        universe=jnp.asarray(universe))
+    np.testing.assert_allclose(np.asarray(streamed), np.asarray(dense),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fused_device_source_matches_host_source(rng):
+    """fuse_source=True (source traced into the one compiled kernel with a
+    traced chunk index) must agree with the host-source path. Fused sources
+    get a TRACED index, so chunks must share a shape — dynamic_slice, not
+    Python indexing."""
+    from jax import lax
+
+    f = 12  # divisible chunking: fused mode requires equal chunk shapes
+    chunk = 4
+    stack = rng.normal(size=(f, D, N)).astype(np.float32)
+    stack[rng.uniform(size=stack.shape) < 0.05] = np.nan
+    returns = jnp.asarray(rng.normal(scale=0.02, size=(D, N)).astype(np.float32))
+    stack_dev = jnp.asarray(stack)
+
+    def device_source(i):
+        return lax.dynamic_slice_in_dim(stack_dev, i * chunk, chunk, axis=0)
+
+    source, slices = host_array_source(stack, chunk)
+    host_stats = streamed_factor_stats(source, len(slices), returns,
+                                       shift_periods=2)
+    fused_stats = streamed_factor_stats(device_source, len(slices), returns,
+                                        shift_periods=2, fuse_source=True)
+    for k in host_stats:
+        np.testing.assert_allclose(np.asarray(fused_stats[k]),
+                                   np.asarray(host_stats[k]), rtol=3e-6,
+                                   atol=1e-6, equal_nan=True, err_msg=k)
+
+    weights = rng.uniform(size=(f, D)).astype(np.float32)
+    host_comp = streamed_weighted_composite(
+        source, [weights[s] for s in slices], transform="zscore")
+    fused_comp = streamed_weighted_composite(
+        device_source, [weights[s] for s in slices], transform="zscore",
+        fuse_source=True)
+    np.testing.assert_allclose(np.asarray(fused_comp), np.asarray(host_comp),
+                               rtol=3e-6, atol=1e-6)
+
+
+def test_streamed_stats_subset(panel):
+    stack, returns, _ = panel
+    source, slices = host_array_source(stack, 5)
+    out = streamed_factor_stats(source, len(slices), jnp.asarray(returns),
+                                stats=("factor_return",))
+    assert set(out) == {"factor_return", "n_pairs"}
+    assert out["factor_return"].shape == (F, D)
+
+
+def test_kernel_cache_reuses_and_clears(panel):
+    """Repeat calls with the same source reuse one cached kernel; the cache
+    is bounded and clear_streaming_cache releases the pinned sources."""
+    from factormodeling_tpu.parallel import clear_streaming_cache
+    from factormodeling_tpu.parallel import streaming as sm
+
+    stack, returns, _ = panel
+    source, slices = host_array_source(stack, 4)
+    clear_streaming_cache()
+    streamed_factor_stats(source, len(slices), jnp.asarray(returns))
+    n_after_first = len(sm._kernel_cache)
+    streamed_factor_stats(source, len(slices), jnp.asarray(returns))
+    assert len(sm._kernel_cache) == n_after_first  # no new kernel built
+    clear_streaming_cache()
+    assert len(sm._kernel_cache) == 0
+    # bound: flooding with distinct fused sources never exceeds the cap
+    for k in range(sm._KERNEL_CACHE_SIZE + 4):
+        src = (lambda kk: (lambda i: jnp.zeros((2, D, N)) + kk))(k)
+        sm._cached_kernel(src, ("stats", 1, ()), lambda: object())
+    assert len(sm._kernel_cache) <= sm._KERNEL_CACHE_SIZE
+    clear_streaming_cache()
+
+
+def test_streamed_composite_rejects_bad_transform(panel):
+    stack, _, _ = panel
+    source, slices = host_array_source(stack, 4)
+    with pytest.raises(ValueError):
+        streamed_weighted_composite(source, [np.ones((4, D))],
+                                    transform="zscores")
+    with pytest.raises(ValueError):
+        streamed_weighted_composite(source, [])
