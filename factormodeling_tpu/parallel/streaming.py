@@ -77,12 +77,12 @@ def _cached_kernel(source, config, build):
     """jit for (source, config), LRU-bounded; ``source`` (None for the host
     path) participates in the key by identity."""
     key = (source, config)
-    fn = _kernel_cache.get(key)
+    fn = _kernel_cache.pop(key, None)
     if fn is None:
         fn = build()
-        _kernel_cache[key] = fn
-        while len(_kernel_cache) > _KERNEL_CACHE_SIZE:
-            _kernel_cache.pop(next(iter(_kernel_cache)))
+    _kernel_cache[key] = fn  # (re)insert at the end: dict order is recency
+    while len(_kernel_cache) > _KERNEL_CACHE_SIZE:
+        _kernel_cache.pop(next(iter(_kernel_cache)))
     return fn
 
 

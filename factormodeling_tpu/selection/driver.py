@@ -123,6 +123,11 @@ def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
     # stats (and with them the rank sort); custom registry entries get the
     # full table — their consumption is unknown
     needs = _METRIC_NEEDS.get(selector, ("ic", "rank_ic", "factor_return"))
+    if selector is icir_top_selector:
+        # it reads exactly one of the two ICIR columns (kwarg-selected);
+        # rank_ic is the lax.sort — skip it when IC_IR is the score
+        use_rank = (method_kwargs or {}).get("use_rank_icir", True)
+        needs = ("rank_ic",) if use_rank else ("ic",)
     ctx = build_selection_context(factors, returns, factor_ret, window,
                                   universe=universe, shift_periods=shift_periods,
                                   stats=needs)

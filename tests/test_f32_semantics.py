@@ -1,0 +1,91 @@
+"""f32-mode semantics: the conftest enables x64 for tight oracle parity,
+but the TPU fast path executes float32 — precision-dependent rules must
+hold there too. These tests run the critical kernels under
+``jax.enable_x64(False)`` (per-call scope)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from factormodeling_tpu import ops
+
+
+def test_constant_window_std_is_exact_zero_in_f32():
+    """The constant-window detector must fire in f32 at any magnitude —
+    raw-moment roundoff is ~eps*scale^2 and eps_f32 is 1e-7, so without the
+    detector a 1e3-scale constant window would report std ~1e-2."""
+    with jax.enable_x64(False):
+        for scale in (1.0, 1e3, 1e-3):
+            x = jnp.full((8, 2), jnp.float32(1.5 * scale))
+            x = x.at[0, 1].set(2.0 * scale)
+            std = np.asarray(ops.ts_std(x, 3))
+            z = np.asarray(ops.ts_zscore(x, 3))
+            assert std.dtype == np.float32
+            assert (std[2:, 0] == 0.0).all(), f"scale {scale}"
+            assert np.isnan(z[2:, 0]).all(), f"scale {scale}"
+            # the non-constant column keeps its true (finite, positive) std
+            assert np.isfinite(std[2, 1]) and std[2, 1] > 0
+
+
+def test_cs_rank_ties_exact_in_f32(rng):
+    """Average-tie ranks are count arithmetic — exact in f32."""
+    with jax.enable_x64(False):
+        x_np = (np.round(rng.normal(size=(12, 9)) * 2) / 2).astype(np.float32)
+        x_np[rng.uniform(size=x_np.shape) < 0.15] = np.nan
+        got = np.asarray(ops.cs_rank(jnp.asarray(x_np)))
+        # reference quirk: denominator counts NaNs (operations.py:58-60)
+        df = pd.DataFrame(x_np)
+        r = df.rank(axis=1, method="average")
+        n = np.full((12, 1), x_np.shape[1])
+        exp = np.where(n > 1, (r - 1) / (n - 1), 0.5).astype(np.float32)
+        np.testing.assert_allclose(got, np.where(np.isnan(x_np), np.nan, exp),
+                                   atol=1e-6, equal_nan=True)
+
+
+def test_mvo_turnover_legs_hold_in_f32(rng):
+    """The ADMM QP path at f32 (the TPU configuration): leg sums +-1 within
+    solver tolerance on accepted days."""
+    from factormodeling_tpu.backtest import SimulationSettings, run_simulation
+
+    with jax.enable_x64(False):
+        d, n = 50, 40
+        returns = rng.normal(scale=0.02, size=(d, n)).astype(np.float32)
+        signal = rng.normal(size=(d, n)).astype(np.float32)
+        s = SimulationSettings(
+            returns=jnp.asarray(returns),
+            cap_flag=jnp.asarray(np.ones((d, n), np.float32)),
+            investability_flag=jnp.ones((d, n), jnp.float32),
+            method="mvo_turnover", lookback_period=10, qp_iters=100,
+            max_weight=0.3, turnover_penalty=0.1)
+        out = jax.jit(run_simulation)(jnp.asarray(signal), s)
+        w = np.nan_to_num(np.asarray(out.weights))[1:]
+        assert w.dtype == np.float32
+        ok = np.asarray(out.diagnostics.solver_ok)[:-1].astype(bool)
+        live = ok & (np.arange(d - 1) > 10) & (np.abs(w).sum(1) > 0)
+        assert live.any()
+        ls = np.where(w > 0, w, 0).sum(1)[live]
+        ss = np.where(w < 0, w, 0).sum(1)[live]
+        np.testing.assert_allclose(ls, 1.0, atol=5e-3)
+        np.testing.assert_allclose(ss, -1.0, atol=5e-3)
+        assert np.isfinite(float(np.nansum(np.asarray(out.result.log_return))))
+
+
+def test_rolling_decay_rank_close_to_oracle_in_f32(rng):
+    """ts_decay / ts_rank in f32 vs the f64 pandas oracle: 1e-4-level
+    agreement (the bench's TPU parity bar)."""
+    with jax.enable_x64(False):
+        x_np = rng.normal(size=(120, 6)).astype(np.float32)
+        x_np[rng.uniform(size=x_np.shape) < 0.05] = np.nan
+        w = 20
+        got_d = np.asarray(ops.ts_decay(jnp.asarray(x_np), w))
+        got_r = np.asarray(ops.ts_rank(jnp.asarray(x_np), w))
+    df = pd.DataFrame(x_np.astype(np.float64))
+    weights = np.arange(1, w + 1)
+    exp_d = df.rolling(w, min_periods=w).apply(
+        lambda s: np.nan if np.isnan(s).any()
+        else (s * weights).sum() / weights.sum(), raw=True).to_numpy()
+    exp_r = df.rolling(w, min_periods=w).apply(
+        lambda s: pd.Series(s).rank(pct=True).iloc[-1], raw=False).to_numpy()
+    np.testing.assert_allclose(got_d, exp_d, atol=1e-4, equal_nan=True)
+    np.testing.assert_allclose(got_r, exp_r, atol=1e-5, equal_nan=True)

@@ -133,10 +133,16 @@ def test_kernel_cache_reuses_and_clears(panel):
     assert len(sm._kernel_cache) == n_after_first  # no new kernel built
     clear_streaming_cache()
     assert len(sm._kernel_cache) == 0
-    # bound: flooding with distinct fused sources never exceeds the cap
+    # bound: flooding with distinct fused sources never exceeds the cap,
+    # and a hot entry is refreshed on hit (LRU, not FIFO)
+    hot = lambda i: jnp.zeros((2, D, N))
+    hot_fn = sm._cached_kernel(hot, ("stats", 1, ()), lambda: object())
     for k in range(sm._KERNEL_CACHE_SIZE + 4):
         src = (lambda kk: (lambda i: jnp.zeros((2, D, N)) + kk))(k)
         sm._cached_kernel(src, ("stats", 1, ()), lambda: object())
+        # touch the hot entry every iteration: it must survive the flood
+        assert sm._cached_kernel(hot, ("stats", 1, ()),
+                                 lambda: object()) is hot_fn
     assert len(sm._kernel_cache) <= sm._KERNEL_CACHE_SIZE
     clear_streaming_cache()
 
