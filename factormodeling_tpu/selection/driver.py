@@ -34,17 +34,22 @@ from factormodeling_tpu.selection.selectors import (
 
 __all__ = ["rolling_selection", "build_selection_context"]
 
-#: daily stats each built-in selector actually reads (see the selector
-#: bodies in selectors.py): icir_top reads rank_IC_IR / IC_IR; momentum,
-#: mvo, pca, and regression consume only the precomputed factor returns.
-#: Keyed by FUNCTION IDENTITY, not method name, so a custom selector
-#: registered over a built-in name still gets the full table.
+#: daily stats each built-in selector actually reads, as a function of its
+#: method_kwargs (see the selector bodies in selectors.py): icir_top reads
+#: exactly one of rank_IC_IR / IC_IR (kwarg-selected; rank_ic is the
+#: lax.sort, skipped when IC_IR is the score); momentum, mvo, pca, and
+#: regression consume only the precomputed factor returns. Keyed by
+#: FUNCTION IDENTITY, not method name, so a custom selector registered over
+#: a built-in name still gets the full table.
+_ALL_STATS = ("ic", "rank_ic", "factor_return")
 _METRIC_NEEDS = {
-    icir_top_selector: ("ic", "rank_ic"),
-    factor_momentum_selector: (),
-    mvo_selector: (),
-    pca_selector: (),
-    regression_selector: (),
+    icir_top_selector: lambda kw: (("rank_ic",)
+                                   if kw.get("use_rank_icir", True)
+                                   else ("ic",)),
+    factor_momentum_selector: lambda kw: (),
+    mvo_selector: lambda kw: (),
+    pca_selector: lambda kw: (),
+    regression_selector: lambda kw: (),
 }
 
 
@@ -52,8 +57,7 @@ def build_selection_context(factors: jnp.ndarray, returns: jnp.ndarray,
                             factor_ret: jnp.ndarray, window: int,
                             *, universe: jnp.ndarray | None = None,
                             shift_periods: int = 2,
-                            stats: tuple = ("ic", "rank_ic",
-                                            "factor_return")) -> SelectionContext:
+                            stats: tuple = _ALL_STATS) -> SelectionContext:
     """Precompute the whole-sample tensors selectors consume.
 
     Args:
@@ -119,15 +123,11 @@ def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
         # processed (also keeps the covariance selectors' window-sized
         # dynamic slices in range)
         return jnp.zeros(factor_ret.shape, factor_ret.dtype)
-    # built-in selectors that never read the metrics table skip its daily
-    # stats (and with them the rank sort); custom registry entries get the
-    # full table — their consumption is unknown
-    needs = _METRIC_NEEDS.get(selector, ("ic", "rank_ic", "factor_return"))
-    if selector is icir_top_selector:
-        # it reads exactly one of the two ICIR columns (kwarg-selected);
-        # rank_ic is the lax.sort — skip it when IC_IR is the score
-        use_rank = (method_kwargs or {}).get("use_rank_icir", True)
-        needs = ("rank_ic",) if use_rank else ("ic",)
+    # built-in selectors only compute the metric stats they actually read
+    # (skipping the rank sort where possible); custom registry entries get
+    # the full table — their consumption is unknown
+    needs_fn = _METRIC_NEEDS.get(selector)
+    needs = needs_fn(method_kwargs or {}) if needs_fn else _ALL_STATS
     ctx = build_selection_context(factors, returns, factor_ret, window,
                                   universe=universe, shift_periods=shift_periods,
                                   stats=needs)

@@ -74,18 +74,17 @@ def test_mvo_turnover_legs_hold_in_f32(rng):
 def test_rolling_decay_rank_close_to_oracle_in_f32(rng):
     """ts_decay / ts_rank in f32 vs the f64 pandas oracle: 1e-4-level
     agreement (the bench's TPU parity bar)."""
+    from tests import pandas_oracle as po
+
     with jax.enable_x64(False):
         x_np = rng.normal(size=(120, 6)).astype(np.float32)
         x_np[rng.uniform(size=x_np.shape) < 0.05] = np.nan
         w = 20
         got_d = np.asarray(ops.ts_decay(jnp.asarray(x_np), w))
         got_r = np.asarray(ops.ts_rank(jnp.asarray(x_np), w))
-    df = pd.DataFrame(x_np.astype(np.float64))
-    weights = np.arange(1, w + 1)
-    exp_d = df.rolling(w, min_periods=w).apply(
-        lambda s: np.nan if np.isnan(s).any()
-        else (s * weights).sum() / weights.sum(), raw=True).to_numpy()
-    exp_r = df.rolling(w, min_periods=w).apply(
-        lambda s: pd.Series(s).rank(pct=True).iloc[-1], raw=False).to_numpy()
+    d, n = x_np.shape
+    s = po.dense_to_long(x_np.astype(np.float64))
+    exp_d = po.long_to_dense(po.o_ts_decay(s, w), d, n)
+    exp_r = po.long_to_dense(po.o_ts_rank(s, w), d, n)
     np.testing.assert_allclose(got_d, exp_d, atol=1e-4, equal_nan=True)
     np.testing.assert_allclose(got_r, exp_r, atol=1e-5, equal_nan=True)
