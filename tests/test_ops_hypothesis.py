@@ -10,6 +10,8 @@ Shapes are FIXED (D=10, N=6) so kernels trace once per (op, window); only
 data varies across examples.
 """
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 from hypothesis import HealthCheck, given, settings
@@ -21,7 +23,9 @@ from tests import pandas_oracle as po
 D, N = 10, 6
 WINDOWS = (1, 2, 3, 5, 10, 13)  # incl. window == D and window > D
 
-_SETTINGS = dict(deadline=None, max_examples=25,
+# FM_FUZZ_MAX=200 (etc.) deepens the search for one-off soak runs
+_SETTINGS = dict(deadline=None,
+                 max_examples=int(os.environ.get("FM_FUZZ_MAX", 25)),
                  suppress_health_check=[HealthCheck.too_slow])
 
 
