@@ -72,6 +72,18 @@ class PanelVocab:
         out[di[keep], si[keep]] = codes[keep]
         return out, len(_uniques)
 
+    def densify_positions(self, index: pd.MultiIndex) -> np.ndarray:
+        """Row position of each (date, symbol) in the caller's series order ->
+        int32 [D, N] (absent cells = INT32_MAX). Used as the ``method='first'``
+        rank tie key: pandas breaks those ties by appearance order, which the
+        sorted-symbol dense layout would otherwise lose."""
+        d, n = self.shape
+        out = np.full((d, n), np.iinfo(np.int32).max, dtype=np.int32)
+        di, si = self.codes(index)
+        keep = (di >= 0) & (si >= 0)
+        out[di[keep], si[keep]] = np.arange(len(index), dtype=np.int32)[keep]
+        return out
+
     def to_series(self, arr, universe: np.ndarray, name=None) -> pd.Series:
         """Dense array -> long Series over the universe cells, sorted index."""
         arr = np.asarray(arr)

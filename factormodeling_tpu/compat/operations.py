@@ -79,11 +79,17 @@ def ts_backfill(series: pd.Series) -> pd.Series:
 
 def cs_rank(series: pd.Series, method: str = "average") -> pd.Series:
     """Per-date [0, 1] rank, (r-1)/(n-1) with the reference's NaN-counting
-    denominator (``operations.py:54``). Only average tie-handling (the
-    reference default) is implemented."""
-    if method != "average":
-        raise NotImplementedError("cs_rank: only method='average' is supported")
-    return roundtrip(series, lambda v, u: k.cs_rank(v, universe=u))
+    denominator (``operations.py:54``). ``method`` follows pandas ``rank``:
+    average/min/max/first/dense — 'first' ties resolve by the series' own row
+    order, like pandas, not by the dense layout's sorted-symbol order."""
+    if method == "first":
+        vocab = PanelVocab.from_indexes(series.index)
+        values, universe = vocab.densify(series)
+        pos = vocab.densify_positions(series.index)
+        out = k.cs_rank(jnp.asarray(values), universe=jnp.asarray(universe),
+                        method="first", tie_order=jnp.asarray(pos))
+        return vocab.align_like(out, series.index, name=series.name)
+    return roundtrip(series, lambda v, u: k.cs_rank(v, universe=u, method=method))
 
 
 def cs_winsor(series: pd.Series, limits=(0.01, 0.99)) -> pd.Series:
@@ -169,15 +175,21 @@ def bucket(series: pd.Series, bin_range=(0.2, 1.0, 0.2)) -> pd.Series:
     return labels
 
 
-def _group_op(series: pd.Series, group: pd.Series, kernel) -> pd.Series:
+def _group_op(series: pd.Series, group: pd.Series, kernel,
+              need_positions: bool = False) -> pd.Series:
     """Shared densify path for per-(date, group) ops: NaN-labelled cells are
-    dropped by pandas groupby -> NaN output, mirrored via a sentinel id."""
+    dropped by pandas groupby -> NaN output, mirrored via a sentinel id.
+    ``need_positions`` additionally passes the series' row-order positions
+    (the pandas ``method='first'`` tie key) to the kernel."""
     vocab = PanelVocab.from_indexes(series.index, group.index)
     values, universe = vocab.densify(series)
     gids, n_groups = vocab.densify_labels(group)
     missing = gids < 0
     gids = np.where(missing, n_groups, gids)  # sentinel bucket, masked below
-    out = kernel(jnp.asarray(values), jnp.asarray(gids), n_groups + 1)
+    args = (jnp.asarray(values), jnp.asarray(gids), n_groups + 1)
+    if need_positions:
+        args += (jnp.asarray(vocab.densify_positions(series.index)),)
+    out = kernel(*args)
     out = np.array(out)  # copy: jax buffers are read-only
     out[missing] = np.nan
     return vocab.align_like(out, series.index, name=series.name)
@@ -200,11 +212,17 @@ def group_normalize(series: pd.Series, group: pd.Series) -> pd.Series:
 
 def group_rank_normalized(series: pd.Series, group: pd.Series,
                           method: str = "average") -> pd.Series:
-    """Per-(date, group) [0, 1] rank, <=1 valid -> 0.5 (``operations.py:152``)."""
-    if method != "average":
-        raise NotImplementedError(
-            "group_rank_normalized: only method='average' is supported")
-    return _group_op(series, group, k.group_rank_normalized)
+    """Per-(date, group) [0, 1] rank, <=1 valid -> 0.5 (``operations.py:152``);
+    ``method`` follows pandas ``rank``: average/min/max/first/dense — 'first'
+    ties resolve by the series' own row order, like pandas."""
+    if method == "first":
+        return _group_op(
+            series, group,
+            lambda v, g, n, pos: k.group_rank_normalized(v, g, n, method="first",
+                                                         tie_order=pos),
+            need_positions=True)
+    return _group_op(series, group,
+                     lambda v, g, n: k.group_rank_normalized(v, g, n, method=method))
 
 
 # ----------------------------------------------------------------- regression

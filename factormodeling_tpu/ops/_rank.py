@@ -18,6 +18,13 @@ from jax import lax
 
 __all__ = ["avg_rank", "masked_quantile", "rank_sorted", "segment_avg_rank"]
 
+_TIE_METHODS = ("average", "min", "max", "first", "dense")
+
+
+def _check_method(method: str) -> None:
+    if method not in _TIE_METHODS:
+        raise ValueError(f"rank method must be one of {_TIE_METHODS}, got {method!r}")
+
 
 def _run_starts_to_last(is_start: jnp.ndarray, axis: int) -> jnp.ndarray:
     """Given run-start flags along ``axis``, the index of the last element of
@@ -44,9 +51,14 @@ def _run_starts_to_first(is_start: jnp.ndarray, axis: int) -> jnp.ndarray:
     return lax.cummax(start_pos, axis=axis)
 
 
-def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -1):
-    """Average-tie 1-based rank of each value among the valid values of its
-    segment, plus the valid count of that segment.
+def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -1,
+                     method: str = "average", tie_order: jnp.ndarray | None = None):
+    """1-based rank of each value among the valid values of its segment, plus
+    the valid count of that segment. ``method`` follows pandas ``rank``:
+    average (default), min, max, first (ties broken by ``tie_order`` — an int
+    array broadcastable to ``values.shape``, lower = earlier; defaults to the
+    position along ``axis`` — at the cost of an extra sort key), dense
+    (consecutive run index).
 
     ``seg_ids`` are int segment labels (any values; < 0 = not in any segment).
     NaN values and negative segments get rank NaN; counts are still reported
@@ -62,6 +74,7 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
     broadcast to members with cummax/cummin index tricks, never gathers —
     TPU lowers arbitrary gathers/scatters poorly.
     """
+    _check_method(method)
     axis = axis % values.ndim
     n = values.shape[axis]
     shape = [1] * values.ndim
@@ -75,8 +88,17 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
     # canonicalized NaNs sort after every real value within their segment
     val_key = jnp.where(valid, values, jnp.nan)
 
-    s_seg, s_val, s_idx = lax.sort((seg_key, val_key, ar), dimension=axis,
-                                   num_keys=2, is_stable=False)
+    # "first" needs ties resolved in caller order: make the tie_order (or the
+    # iota) an extra sort key. Other methods are order-independent in a run.
+    if method == "first":
+        tie_key = (ar if tie_order is None else
+                   jnp.broadcast_to(tie_order, values.shape).astype(jnp.int32))
+        s_seg, s_val, _, s_idx = lax.sort((seg_key, val_key, tie_key, ar),
+                                          dimension=axis, num_keys=3,
+                                          is_stable=False)
+    else:
+        s_seg, s_val, s_idx = lax.sort((seg_key, val_key, ar), dimension=axis,
+                                       num_keys=2, is_stable=False)
     valid_sorted = ~jnp.isnan(s_val)
 
     def shift_one(a):
@@ -95,8 +117,21 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
     tie_last = _run_starts_to_last(tie_start, axis)
 
     # within a segment run the valid cells come first, so rank = offset + 1
-    avg_rank_sorted = 0.5 * ((tie_first - seg_first + 1) + (tie_last - seg_first + 1))
-    avg_rank_sorted = jnp.where(valid_sorted, avg_rank_sorted, jnp.nan)
+    if method == "average":
+        rank_sorted_ = 0.5 * ((tie_first - seg_first + 1) + (tie_last - seg_first + 1))
+    elif method == "min":
+        rank_sorted_ = (tie_first - seg_first + 1).astype(values.dtype)
+    elif method == "max":
+        rank_sorted_ = (tie_last - seg_first + 1).astype(values.dtype)
+    elif method == "first":
+        rank_sorted_ = (ar - seg_first + 1).astype(values.dtype)
+    else:  # dense: index of this tie run among the segment's valid runs
+        run_ind = (tie_start & valid_sorted).astype(jnp.int32)
+        cs_runs = jnp.cumsum(run_ind, axis=axis)
+        base_at_start = jnp.where(seg_start, cs_runs - run_ind, -1)
+        base = lax.cummax(base_at_start, axis=axis)
+        rank_sorted_ = (cs_runs - base).astype(values.dtype)
+    avg_rank_sorted = jnp.where(valid_sorted, rank_sorted_, jnp.nan)
 
     # per-segment valid count broadcast to every member (NaN members too):
     # csum at the segment's last position minus csum just before its first,
@@ -118,8 +153,10 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
     return ranks, counts
 
 
-def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=()):
-    """Average-tie 1-based ranks **in sorted order**, from ONE single-key sort.
+def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=(),
+                method: str = "average"):
+    """1-based ranks **in sorted order** (``method`` = any pandas tie rule,
+    average by default), from ONE single-key sort.
 
     Returns ``(ranks_sorted, valid_sorted, carried)`` where ``ranks_sorted[i]``
     is the rank of the i-th smallest value, ``valid_sorted`` marks non-NaN
@@ -134,6 +171,7 @@ def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=()):
     see ``metrics/factor_metrics.py``. Order-dependent consumers carry an
     iota and pay a second sort to invert (:func:`avg_rank`).
     """
+    _check_method(method)
     axis = axis % values.ndim
     n = values.shape[axis]
     # canonicalize NaN sign: XLA total order sorts -NaN first but +NaN last
@@ -153,26 +191,56 @@ def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=()):
          jnp.zeros_like(lax.slice_in_dim(valid_sorted, 0, n - 1, axis=axis))],
         axis=axis)
     tie_start = first_col | (s_key != shift_one(s_key))  # NaN != NaN -> own run
-    tie_first = _run_starts_to_first(tie_start, axis)
-    tie_last = _run_starts_to_last(tie_start, axis)
-    ranks_sorted = 0.5 * (tie_first + tie_last).astype(values.dtype) + 1.0
+    if method == "average":
+        tie_first = _run_starts_to_first(tie_start, axis)
+        tie_last = _run_starts_to_last(tie_start, axis)
+        ranks_sorted = 0.5 * (tie_first + tie_last).astype(values.dtype) + 1.0
+    elif method == "min":
+        ranks_sorted = _run_starts_to_first(tie_start, axis).astype(values.dtype) + 1.0
+    elif method == "max":
+        ranks_sorted = _run_starts_to_last(tie_start, axis).astype(values.dtype) + 1.0
+    elif method == "first":
+        # stable sort + NaNs-last: among valid cells, position IS the rank
+        shape = [1] * values.ndim
+        shape[axis] = n
+        ranks_sorted = jnp.broadcast_to(
+            jnp.arange(1, n + 1, dtype=values.dtype).reshape(shape), values.shape)
+    else:  # dense
+        ranks_sorted = jnp.cumsum((tie_start & valid_sorted).astype(jnp.int32),
+                                  axis=axis).astype(values.dtype)
     ranks_sorted = jnp.where(valid_sorted, ranks_sorted, jnp.nan)
     return ranks_sorted, valid_sorted, tuple(s_carry)
 
 
-def avg_rank(values: jnp.ndarray, *, axis: int = -1) -> jnp.ndarray:
-    """Average-tie 1-based rank among non-NaN values along ``axis`` (NaN -> NaN),
-    i.e. ``scipy.stats.rankdata`` / pandas ``rank(method='average')``.
+def avg_rank(values: jnp.ndarray, *, axis: int = -1, method: str = "average",
+             tie_order: jnp.ndarray | None = None) -> jnp.ndarray:
+    """1-based rank among non-NaN values along ``axis`` (NaN -> NaN), i.e.
+    pandas ``rank(method=...)`` — average ties by default. For
+    ``method='first'``, ``tie_order`` (int, broadcastable, lower = earlier)
+    overrides the default position-along-axis tie resolution.
 
     Two single-key sorts (rank, then permutation inversion) — TPU lowers a
     one-key sort ~10x faster than the multi-key variadic form, and sort-based
     inversion beats a scatter, which TPU serializes."""
+    _check_method(method)
     axis = axis % values.ndim
     n = values.shape[axis]
     shape = [1] * values.ndim
     shape[axis] = n
     ar = jnp.arange(n, dtype=jnp.int32).reshape(shape)
-    ranks_sorted, _, (s_idx,) = rank_sorted(values, axis=axis, carry=(ar,))
+    if method == "first" and tie_order is not None:
+        # two-key sort (value, tie_order); among valid cells position = rank
+        key = jnp.where(jnp.isnan(values), jnp.nan, values)
+        tie_key = jnp.broadcast_to(tie_order, values.shape).astype(jnp.int32)
+        s_key, _, s_idx = lax.sort(
+            (key, tie_key, jnp.broadcast_to(ar, values.shape)),
+            dimension=axis, num_keys=2, is_stable=False)
+        pos = jnp.broadcast_to(
+            jnp.arange(1, n + 1, dtype=values.dtype).reshape(shape), values.shape)
+        ranks_sorted = jnp.where(jnp.isnan(s_key), jnp.nan, pos)
+    else:
+        ranks_sorted, _, (s_idx,) = rank_sorted(values, axis=axis, carry=(ar,),
+                                                method=method)
     _, ranks = lax.sort((s_idx, ranks_sorted), dimension=axis, num_keys=1,
                         is_stable=False)
     return ranks

@@ -56,12 +56,16 @@ def _mask_input(x, universe):
     return jnp.where(universe, x, jnp.nan)
 
 
-def cs_rank(x: jnp.ndarray, universe: jnp.ndarray | None = None) -> jnp.ndarray:
+def cs_rank(x: jnp.ndarray, universe: jnp.ndarray | None = None,
+            method: str = "average",
+            tie_order: jnp.ndarray | None = None) -> jnp.ndarray:
     """Per-date rank normalized to [0, 1]: ``(rank - 1) / (n - 1)`` with
-    average ties, where ``n`` is the full group size *including NaN rows*
-    (reference quirk, ``operations.py:58-60``); single-row dates -> 0.5."""
+    pandas tie ``method`` (default average), where ``n`` is the full group
+    size *including NaN rows* (reference quirk, ``operations.py:58-60``);
+    single-row dates -> 0.5. ``tie_order`` (int, lower = earlier) resolves
+    ``method='first'`` ties; defaults to asset-column order."""
     x = _mask_input(x, universe)
-    r = avg_rank(x, axis=_ASSET_AXIS)
+    r = avg_rank(x, axis=_ASSET_AXIS, method=method, tie_order=tie_order)
     n = _universe_count(x, universe)
     out = (r - 1.0) / (n - 1.0)
     out = jnp.where(n == 1, 0.5, out)

@@ -120,13 +120,17 @@ def group_normalize(x: jnp.ndarray, group_ids: jnp.ndarray, num_groups: int) -> 
 
 
 def group_rank_normalized(x: jnp.ndarray, group_ids: jnp.ndarray,
-                          num_groups: int) -> jnp.ndarray:
-    """Per-(date, group) [0, 1] rank with average ties, NaNs preserved; groups
-    with <= 1 valid row -> 0.5 for every row of the group, NaN rows included
-    (reference ``operations.py:152-168``)."""
+                          num_groups: int, method: str = "average",
+                          tie_order: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-(date, group) [0, 1] rank with pandas tie ``method`` (default
+    average), NaNs preserved; groups with <= 1 valid row -> 0.5 for every row
+    of the group, NaN rows included (reference ``operations.py:152-168``).
+    ``tie_order`` (int, lower = earlier) resolves ``method='first'`` ties;
+    defaults to asset-column order."""
     del num_groups  # sort-based; no table needed
     gids = jnp.broadcast_to(group_ids, x.shape).astype(jnp.int32)
-    ranks, counts = segment_avg_rank(x, gids, axis=_ASSET_AXIS)
+    ranks, counts = segment_avg_rank(x, gids, axis=_ASSET_AXIS, method=method,
+                                     tie_order=tie_order)
     few = counts <= 1
     out = (ranks - 1.0) / (counts - 1.0)
     out = jnp.where(few, 0.5, out)

@@ -173,6 +173,9 @@ def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
                                                         "none"):
         raise ValueError(f"unknown transform {transform!r}; valid: "
                          "'zscore', 'rank', 'none', or a callable")
+    chunk_weights = list(chunk_weights)
+    if not chunk_weights:
+        raise ValueError("chunk_weights is empty")
 
     one = _composite_kernel(source if fuse_source else None, transform)
     total = None
@@ -180,8 +183,6 @@ def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
         arg0 = i if fuse_source else source(i)
         part = one(arg0, jnp.asarray(w), universe)
         total = part if total is None else total + part
-    if total is None:
-        raise ValueError("chunk_weights is empty")
     return total
 
 

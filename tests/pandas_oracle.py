@@ -98,9 +98,9 @@ def _by_date(s: pd.Series):
     return s.groupby(level="date")
 
 
-def o_cs_rank(s):
+def o_cs_rank(s, method="average"):
     def norm(g):
-        r = g.rank(method="average")
+        r = g.rank(method=method)
         if len(r) <= 1:
             return 0.5
         return (r - 1) / (len(r) - 1)
@@ -171,13 +171,13 @@ def o_group_normalize(s, grp):
     return _by_date_group(s, grp).transform(f)
 
 
-def o_group_rank_normalized(s, grp):
+def o_group_rank_normalized(s, grp, method="average"):
     def f(g):
         ok = g.dropna()
         if len(ok) <= 1:
             return pd.Series(0.5, index=g.index)
         out = pd.Series(np.nan, index=g.index)
-        out.loc[ok.index] = (ok.rank(method="average") - 1) / (len(ok) - 1)
+        out.loc[ok.index] = (ok.rank(method=method) - 1) / (len(ok) - 1)
         return out
     return _by_date_group(s, grp).transform(f)
 

@@ -53,6 +53,44 @@ def test_cs_ops(rng, name, args):
                         getattr(po, f"o_{name}")(s, *args))
 
 
+@pytest.mark.parametrize("method", ["average", "min", "max", "first", "dense"])
+def test_cs_rank_tie_methods(rng, method):
+    s = make_series(rng)
+    # discretize so ties actually occur
+    s = np.round(s * 2) / 2
+    assert_series_match(cop.cs_rank(s, method=method),
+                        po.o_cs_rank(s, method=method))
+
+
+@pytest.mark.parametrize("method", ["average", "min", "max", "first", "dense"])
+def test_group_rank_tie_methods(rng, method):
+    s = make_series(rng)
+    s = np.round(s * 2) / 2
+    g = make_groups(rng, s.index)
+    assert_series_match(cop.group_rank_normalized(s, g, method=method),
+                        po.o_group_rank_normalized(s, g, method=method))
+
+
+def test_rank_first_ties_by_appearance_order(rng):
+    """pandas rank(method='first') breaks ties by row order; the dense layout
+    must not silently substitute sorted-symbol order."""
+    dates = pd.to_datetime(["2020-01-02"] * 3 + ["2020-01-03"] * 3)
+    syms = ["b", "a", "c"] * 2  # appearance order != sorted order
+    idx = pd.MultiIndex.from_arrays([dates, syms], names=["date", "symbol"])
+    s = pd.Series([1.0, 1.0, 2.0, 3.0, 3.0, 3.0], index=idx)
+    assert_series_match(cop.cs_rank(s, method="first"),
+                        po.o_cs_rank(s, method="first"))
+    g = pd.Series(["x"] * 6, index=idx)
+    assert_series_match(cop.group_rank_normalized(s, g, method="first"),
+                        po.o_group_rank_normalized(s, g, method="first"))
+
+
+def test_rank_bad_method_raises(rng):
+    s = make_series(rng)
+    with pytest.raises(ValueError):
+        cop.cs_rank(s, method="keep")
+
+
 def test_cs_bool_and_elementwise(rng):
     s = make_series(rng)
     got = cop.cs_bool(s > 0, 1.0, -1.0)

@@ -7,6 +7,7 @@ this framework); ``examples/run_reference_notebook.py`` is the same flow as
 a script. Shapes can be trimmed via FM_NOTEBOOK_DATES / FM_NOTEBOOK_SYMBOLS.
 """
 
+import hashlib
 import os
 import sys
 from pathlib import Path
@@ -20,8 +21,28 @@ from examples.run_reference_notebook import DEFAULT_NOTEBOOK  # noqa: E402
 
 NOTEBOOK = Path(DEFAULT_NOTEBOOK)
 
-pytestmark = pytest.mark.skipif(
-    not NOTEBOOK.exists(), reason="reference notebook not available")
+# The test exec()s the notebook's code cells verbatim, so pin the notebook by
+# content hash: a modified upstream checkout must not silently execute new
+# code in CI. Set FM_NOTEBOOK_ALLOW_UNPINNED=1 to run anyway (and then update
+# the pin if the change is legitimate).
+PINNED_SHA256 = "08e9929ea91de6057a6a490baf99bbabb2683f9386d595fd14340330a7ff3c49"
+
+
+def _notebook_skip_reason():
+    if not NOTEBOOK.exists():
+        return "reference notebook not available"
+    if os.environ.get("FM_NOTEBOOK_ALLOW_UNPINNED") == "1":
+        return None
+    digest = hashlib.sha256(NOTEBOOK.read_bytes()).hexdigest()
+    if digest != PINNED_SHA256:
+        return (f"reference notebook content hash {digest[:12]}... does not "
+                f"match the pinned {PINNED_SHA256[:12]}...; refusing to exec "
+                "unreviewed code (set FM_NOTEBOOK_ALLOW_UNPINNED=1 to override)")
+    return None
+
+
+_SKIP = _notebook_skip_reason()
+pytestmark = pytest.mark.skipif(_SKIP is not None, reason=str(_SKIP))
 
 
 def test_reference_notebook_runs_unmodified(tmp_path):

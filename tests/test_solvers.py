@@ -139,3 +139,33 @@ def test_vmap_batch_of_problems(rng):
         f_got = 0.5 * xs[k] @ Ps[k] @ xs[k] + qs[k] @ xs[k]
         np.testing.assert_allclose(xs[k].sum(), 1.0, atol=1e-8)
         assert f_got <= f_exp + 1e-8
+
+
+def test_unrolled_segment_path_matches_rolled(rng, monkeypatch):
+    """The TPU unrolled segment schedule (`_unroll_factor() > 1`) is dispatched
+    on backend, so CPU CI never exercises it by default. Force a small unroll
+    (well below the full-unroll size that crashes XLA CPU's compile) and
+    require exact agreement with the rolled path — the two paths execute the
+    same op sequence, only scheduled differently."""
+    from factormodeling_tpu.solvers import admm_qp
+
+    f = 10
+    ret = rng.normal(0, 1e-3, size=(60, f))
+    P = 2 * (np.cov(ret, rowvar=False) + 1e-8 * np.eye(f))
+    q = -ret.mean(0)
+    lo, hi = np.zeros(f), np.full(f, 0.3)
+    E, b = np.ones((1, f)), np.array([1.0])
+    prob = BoxQPProblem(jnp.array(q), jnp.array(lo), jnp.array(hi),
+                        jnp.array(E), jnp.array(b), jnp.array(0.0),
+                        jnp.zeros(f))
+
+    # iters chosen to hit partial final segments (173 = 6*25 + 23)
+    for iters in (0, 7, 173):
+        rolled = admm_solve_dense(jnp.array(P), prob, iters=iters)
+        monkeypatch.setattr(admm_qp, "_unroll_factor", lambda: 4)
+        unrolled = admm_solve_dense(jnp.array(P), prob, iters=iters)
+        monkeypatch.undo()
+        np.testing.assert_array_equal(np.asarray(rolled.x),
+                                      np.asarray(unrolled.x))
+        np.testing.assert_array_equal(float(rolled.primal_residual),
+                                      float(unrolled.primal_residual))
