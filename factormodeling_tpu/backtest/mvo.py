@@ -18,6 +18,15 @@ Cholesky, T = lookback ~ 60). Plain ``mvo`` runs all dates through a chunked
 ``lax.map``; ``mvo_turnover`` is a ``lax.scan`` because yesterday's weights
 enter the objective (``portfolio_simulation.py:206-225``).
 
+``SimulationSettings.covariance="risk_model"`` swaps the trailing sample
+window for a rolling statistical factor model (:mod:`factormodeling_tpu.risk`)
+refit every ``risk_refit_every`` days: ``Sigma = B diag(f) B' + diag(idio)``
+rides the identical Woodbury path with the per-asset idio diagonal as the
+vector alpha and ``V = B'`` (k x N, k ~ 10 << T), so a risk-model backtest is
+*cheaper* per ADMM iteration than the sample-window one. The reference has no
+such mode — its MVO is sample-covariance only — this is a TPU-side extension
+mirrored on :func:`factormodeling_tpu.risk.optimal_weights`.
+
 Fallback ladder, matching the reference's failure semantics:
 - either leg empty -> flat day (handled by the engine);
 - universe row has < 2 names -> flat day (``portfolio_simulation.py:119``);
@@ -78,8 +87,15 @@ def _shrunk_terms(c: jnp.ndarray, t_used, lam: float, dtype):
 
 
 def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
-               s: SimulationSettings, turnover: bool):
+               s: SimulationSettings, turnover: bool, risk_model=None):
     """One date's MVO solve with the full fallback ladder.
+
+    ``risk_model``: optional ``(loadings [N, k], factor_var [k], idio [N],
+    history)`` tuple — the day's statistical-factor covariance
+    ``Sigma = B diag(f) B' + diag(idio)``, consumed through the same Woodbury
+    path with the per-asset idio diagonal as the vector alpha (``history`` =
+    rows behind the fit, driving the ladder in place of the sample window's
+    ``t_used``). ``None`` -> the reference's trailing sample covariance.
 
     Returns ``(w [N], primal_residual [], solver_ok [])`` — the residual and
     acceptance flag feed :class:`~factormodeling_tpu.backtest.diagnostics.
@@ -89,9 +105,13 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     pos = signal_row > 0
     neg = signal_row < 0
 
-    c, t_used = _window_factors(returns, today, s.lookback_period)
-    alpha, s_row = _shrunk_terms(c, t_used, s.shrinkage_intensity, dtype)
-    s_vec = jnp.where(jnp.arange(s.lookback_period) < t_used, s_row, 0.0)
+    if risk_model is None:
+        c, t_used = _window_factors(returns, today, s.lookback_period)
+        alpha, s_row = _shrunk_terms(c, t_used, s.shrinkage_intensity, dtype)
+        s_vec = jnp.where(jnp.arange(s.lookback_period) < t_used, s_row, 0.0)
+    else:
+        loadings, factor_var, idio, t_used = risk_model
+        alpha, c, s_vec = idio, loadings.T, factor_var  # V = B': [k, N]
 
     lo, hi, E, b = leg_constraints(signal_row, s.max_weight, dtype)
     if turnover:
@@ -134,16 +154,61 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     return w, resid, solver_ok | (t_used < 2)
 
 
+def _risk_model_stack(s: SimulationSettings):
+    """Rolling refits of the statistical factor risk model, stacked along a
+    refit axis ``R = ceil(D / risk_refit_every)``.
+
+    Model ``j`` is fit on the (at most ``risk_lookback``) return rows strictly
+    before day ``j * risk_refit_every``; dates in block ``j`` consume model
+    ``j``, so no estimate ever sees its own block — no lookahead. Until the
+    first refit with history (block 0), the ladder's no-history fallback
+    applies. One chunked ``lax.map`` over refit days keeps peak memory at
+    ``mvo_batch`` windows.
+    """
+    from factormodeling_tpu import risk as _risk
+
+    d, n = s.returns.shape
+    lb = min(s.risk_lookback, d)
+    r = -(-d // s.risk_refit_every)
+
+    def fit_one(day):
+        start = jnp.maximum(day - lb, 0)
+        rows = lax.dynamic_slice(s.returns, (start, jnp.zeros_like(start)),
+                                 (lb, n))
+        used = (jnp.arange(lb) < (day - start))[:, None]
+        m = _risk.statistical_risk_model(jnp.where(used, rows, jnp.nan),
+                                         s.risk_factors)
+        return m.loadings, m.factor_var, m.idio_var
+
+    days = (jnp.arange(r) * s.risk_refit_every).astype(jnp.int32)
+    stacks = lax.map(fit_one, days, batch_size=min(s.mvo_batch, r))
+    return stacks
+
+
+def _risk_model_for_day(stacks, today, s: SimulationSettings):
+    """The day's ``(loadings, factor_var, idio, history)`` from the refit
+    stack — ``history`` is the row count behind the block's fit, which drives
+    the fallback ladder exactly like the sample window's ``t_used``."""
+    loadings_s, fvar_s, idio_s = stacks
+    j = today // s.risk_refit_every
+    hist = jnp.minimum(j * s.risk_refit_every, min(s.risk_lookback,
+                                                   s.returns.shape[0]))
+    return loadings_s[j], fvar_s[j], idio_s[j], hist
+
+
 def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
     """Per-date minimum-variance weights for the whole panel
     (``portfolio_simulation.py:183-204``). Dates are independent -> chunked
     ``lax.map``. Returns (weights [D, N], long_count [D], short_count [D])."""
     d, n = signal.shape
     pos, neg, flat = leg_masks(signal)
+    stacks = _risk_model_stack(s) if s.covariance == "risk_model" else None
 
     def one(today):
+        rm = (None if stacks is None
+              else _risk_model_for_day(stacks, today, s))
         return _solve_day(signal[today], s.returns, today, jnp.zeros(n, s.returns.dtype),
-                          s, turnover=False)
+                          s, turnover=False, risk_model=rm)
 
     w, resid, ok = lax.map(one, jnp.arange(d), batch_size=s.mvo_batch)
     return _finalize(w, signal, s, pos, neg, flat, resid, ok)
@@ -157,10 +222,13 @@ def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
     # the reference's _get_previous_weights reads the last stored row, which
     # is the zero row on flat days — mirror that by carrying the final row.
     zero_day = flat | (_universe_count(signal, s) < 2)
+    stacks = _risk_model_stack(s) if s.covariance == "risk_model" else None
 
     def step(w_prev, today):
+        rm = (None if stacks is None
+              else _risk_model_for_day(stacks, today, s))
         w, resid, ok = _solve_day(signal[today], s.returns, today, w_prev, s,
-                                  turnover=True)
+                                  turnover=True, risk_model=rm)
         w = jnp.where(zero_day[today], 0.0, w)
         return w, (w, resid, ok)
 

@@ -49,6 +49,16 @@ class SimulationSettings:
     turnover_penalty: float = 0.1
     return_weight: float = 0.0
 
+    # MVO covariance source: "sample" = the reference's trailing-window sample
+    # covariance (portfolio_simulation.py:315-374); "risk_model" = a rolling
+    # statistical factor model (factormodeling_tpu.risk) refit every
+    # ``risk_refit_every`` days on the trailing ``risk_lookback`` rows —
+    # Sigma = B diag(f) B' + diag(idio) rides the same Woodbury ADMM path.
+    covariance: str = dataclasses.field(default="sample", metadata=dict(static=True))
+    risk_factors: int = dataclasses.field(default=10, metadata=dict(static=True))
+    risk_lookback: int = dataclasses.field(default=252, metadata=dict(static=True))
+    risk_refit_every: int = dataclasses.field(default=21, metadata=dict(static=True))
+
     # ADMM solver knobs (device-side replacement for OSQP/SLSQP)
     qp_iters: int = dataclasses.field(default=500, metadata=dict(static=True))
     qp_rho: float = dataclasses.field(default=2.0, metadata=dict(static=True))
@@ -57,6 +67,8 @@ class SimulationSettings:
     def __post_init__(self):
         if self.method not in ("equal", "linear", "mvo", "mvo_turnover"):
             raise ValueError(f"Unknown method {self.method}")
+        if self.covariance not in ("sample", "risk_model"):
+            raise ValueError(f"Unknown covariance {self.covariance}")
 
     @property
     def shape(self):

@@ -135,6 +135,8 @@ def _ts_moments(x: jnp.ndarray, window: int):
 @_over_universe
 def ts_std(x: jnp.ndarray, window: int) -> jnp.ndarray:
     """Trailing-window sample std, ddof=1 (reference ``operations.py:14``)."""
+    if _use_streaming(x, window):
+        return _pw.ts_std_streaming(x, window)
     _, var, full = _ts_moments(x, window)
     return jnp.where(full, jnp.sqrt(var), jnp.nan)
 
@@ -143,6 +145,8 @@ def ts_std(x: jnp.ndarray, window: int) -> jnp.ndarray:
 def ts_zscore(x: jnp.ndarray, window: int) -> jnp.ndarray:
     """(x - rolling mean) / rolling std, std == 0 -> NaN (reference
     ``operations.py:18-21``)."""
+    if _use_streaming(x, window):
+        return _pw.ts_zscore_streaming(x, window)
     mean, var, full = _ts_moments(x, window)
     std = jnp.sqrt(var)
     std = jnp.where(std == 0.0, jnp.nan, std)

@@ -39,6 +39,7 @@ multi-chip analog shards the factor axis over a mesh instead
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
 
 import jax
@@ -101,18 +102,50 @@ def host_array_source(stack, chunk: int):
     return (lambda i: jnp.asarray(stack[slices[i]])), slices
 
 
+def _prefetched(source, n_chunks: int, prefetch: int):
+    """Iterate ``source(0..n_chunks-1)`` with up to ``prefetch`` chunks loaded
+    ahead on a background thread.
+
+    The host side of a source (numpy slice / disk read / network fetch) runs
+    serially with device compute in the naive loop — the device sits idle for
+    the slice+transfer of every chunk. A one-thread executor overlaps chunk
+    i+1's host work (and its async ``device_put``) with chunk i's dispatch,
+    which is the classic double-buffer; ``prefetch`` bounds in-flight chunks
+    so device memory holds at most ``prefetch + 1`` chunk buffers. Thread
+    safety: ``jax.device_put``/``jnp.asarray`` are safe off-thread; the
+    *compute* dispatch stays on the caller's thread.
+    """
+    if prefetch <= 0:
+        for i in range(n_chunks):
+            yield source(i)
+        return
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pending = [pool.submit(source, i)
+                   for i in range(min(prefetch, n_chunks))]
+        for i in range(n_chunks):
+            nxt = i + len(pending)
+            if nxt < n_chunks:
+                pending.append(pool.submit(source, nxt))
+            yield pending.pop(0).result()
+
+
 def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
                           n_chunks: int, returns: jnp.ndarray, *,
                           shift_periods: int = 1,
                           universe: jnp.ndarray | None = None,
                           stats: tuple = ("ic", "rank_ic", "factor_return"),
-                          fuse_source: bool = False) -> dict:
+                          fuse_source: bool = False,
+                          prefetch: int = 0) -> dict:
     """Pass 1: per-(factor, date) stats for a streamed stack.
 
     Returns the :func:`daily_factor_stats` dict with every array
     ``[F_total, D]``, factors ordered by chunk index. Device memory high-water
-    is one chunk plus its stats temporaries. ``fuse_source=True`` traces the
-    source into the per-chunk kernel (device sources — see module docs).
+    is ``1 + prefetch`` chunks plus the stats temporaries. ``fuse_source=True``
+    traces the source into the per-chunk kernel (device sources — see module
+    docs); ``prefetch`` (host sources only, opt-in) loads that many chunks
+    ahead on a background thread so host slice/transfer overlaps device
+    compute — double-buffering at 1, at the cost of one extra resident chunk
+    buffer (size your chunks accordingly).
     """
     if n_chunks <= 0:
         raise ValueError(f"n_chunks must be positive, got {n_chunks}")
@@ -122,7 +155,8 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
     if fuse_source:
         parts = [one(i, returns, universe) for i in range(n_chunks)]
     else:
-        parts = [one(source(i), returns, universe) for i in range(n_chunks)]
+        parts = [one(chunk, returns, universe)
+                 for chunk in _prefetched(source, n_chunks, prefetch)]
     return {k: jnp.concatenate([p[k] for p in parts], axis=0)
             for k in parts[0]}
 
@@ -150,7 +184,8 @@ def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
                                 chunk_weights: Sequence[jnp.ndarray],
                                 *, transform: Callable | str = "zscore",
                                 universe: jnp.ndarray | None = None,
-                                fuse_source: bool = False) -> jnp.ndarray:
+                                fuse_source: bool = False,
+                                prefetch: int = 0) -> jnp.ndarray:
     """Pass 2: ``sum_f w[f, d] * transform(stack)[f, d, n]`` streamed.
 
     Args:
@@ -166,6 +201,10 @@ def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
         ``float[C, D, N] -> float[C, D, N]``.
       fuse_source: trace the source into the per-chunk kernel (device
         sources — see module docs).
+      prefetch: host sources only, opt-in — chunks loaded ahead on a
+        background thread so host slice/transfer overlaps device compute
+        (double-buffering at 1); each prefetched chunk is one extra
+        resident device buffer.
 
     Returns the composite ``float[D, N]``.
     """
@@ -179,8 +218,11 @@ def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
 
     one = _composite_kernel(source if fuse_source else None, transform)
     total = None
-    for i, w in enumerate(chunk_weights):
-        arg0 = i if fuse_source else source(i)
+    if fuse_source:
+        chunks = iter(range(len(chunk_weights)))
+    else:
+        chunks = _prefetched(source, len(chunk_weights), prefetch)
+    for w, arg0 in zip(chunk_weights, chunks):
         part = one(arg0, jnp.asarray(w), universe)
         total = part if total is None else total + part
     return total

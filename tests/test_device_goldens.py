@@ -1,0 +1,34 @@
+"""f32 end-to-end goldens: the demo pipeline in float32 against the pin
+recorded on the device backend (``tools/device_goldens.py --record``).
+
+The main golden suite pins float64 numbers; this one catches f32-semantics
+drift (the precision the TPU actually runs) in CI without TPU access. The
+suite's global x64 flag is lowered for the duration of the run via
+``jax.experimental.disable_x64`` so every kernel sees f32 inputs.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+PIN_PATH = Path(__file__).resolve().parent / "goldens" / "device_f32.json"
+
+pytestmark = pytest.mark.skipif(
+    not PIN_PATH.exists(),
+    reason="no device_f32 pin recorded (tools/device_goldens.py --record)")
+
+
+def test_pipeline_f32_matches_device_pin(tmp_path):
+    import jax
+
+    import sys
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.device_goldens import check, fingerprint
+
+    with jax.enable_x64(False):
+        fp = fingerprint(workdir=tmp_path)
+
+    pin = json.loads(PIN_PATH.read_text())
+    fails = check(fp, pin)
+    assert not fails, "\n".join(fails)

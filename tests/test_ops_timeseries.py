@@ -100,6 +100,34 @@ def test_pallas_streaming_kernels_match_xla(rng, window):
         np.asarray(ops.ts_rank(xd, window)), atol=1e-6, equal_nan=True)
 
 
+@pytest.mark.parametrize("window", [1, 2, 9, 45])
+def test_pallas_moment_kernels_match_xla(rng, window):
+    """ts_std/ts_zscore streaming kernels vs the XLA moments path, including
+    the exact-0 constant-window rule and NaN propagation."""
+    pytest.importorskip("jax.experimental.pallas.tpu")
+    from factormodeling_tpu.ops._pallas_window import (
+        ts_std_streaming, ts_zscore_streaming)
+
+    x = rng.normal(size=(3, 60, 20)).astype(np.float32)
+    x[rng.uniform(size=x.shape) < 0.1] = np.nan
+    x[0, 10:10 + max(window, 2), 3] = 7.25  # constant window -> std exactly 0
+    xd = jnp.array(x)
+    # ground truth in f64 (the kernel's two-pass form is MORE accurate than
+    # the XLA raw-moment path in f32, so parity is asserted against the f64
+    # oracle, not the f32 XLA numbers)
+    exp_std = np.asarray(ops.ts_std(jnp.array(x.astype(np.float64)), window))
+    exp_z = np.asarray(ops.ts_zscore(jnp.array(x.astype(np.float64)), window))
+    np.testing.assert_allclose(
+        np.asarray(ts_std_streaming(xd, window, interpret=True)),
+        exp_std, rtol=1e-4, atol=1e-6, equal_nan=True)
+    np.testing.assert_allclose(
+        np.asarray(ts_zscore_streaming(xd, window, interpret=True)),
+        exp_z, rtol=1e-3, atol=1e-4, equal_nan=True)
+    if window >= 2:
+        got = np.asarray(ts_std_streaming(xd, window, interpret=True))
+        assert got[0, 10 + window - 1, 3] == 0.0
+
+
 def test_pallas_streaming_multi_tile_handoff(rng):
     """Windows that straddle date-tile boundaries (d > d_blk) must see the
     previous tile's history through the VMEM state hand-off."""
@@ -117,6 +145,12 @@ def test_pallas_streaming_multi_tile_handoff(rng):
         np.testing.assert_allclose(
             np.asarray(ts_rank_streaming(xd, w, interpret=True)),
             np.asarray(ops.ts_rank(xd, w)), atol=1e-5, equal_nan=True)
+    from factormodeling_tpu.ops._pallas_window import ts_zscore_streaming
+    exp_z = np.asarray(ops.ts_zscore(jnp.array(
+        np.asarray(xd, dtype=np.float64)), 100))
+    np.testing.assert_allclose(
+        np.asarray(ts_zscore_streaming(xd, 100, interpret=True)),
+        exp_z, rtol=1e-3, atol=1e-4, equal_nan=True)
 
 
 def test_pallas_dispatch_is_tpu_only():

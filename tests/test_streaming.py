@@ -155,3 +155,32 @@ def test_streamed_composite_rejects_bad_transform(panel):
                                     transform="zscores")
     with pytest.raises(ValueError):
         streamed_weighted_composite(source, [])
+
+
+def test_prefetched_host_source_matches_serial(rng):
+    """prefetch>0 must not reorder or drop chunks; results identical to the
+    serial path."""
+    from factormodeling_tpu.parallel import streaming
+
+    f, d, n, chunk = 12, 20, 16, 3
+    stack = rng.normal(size=(f, d, n)).astype(np.float32)
+    rets = jnp.asarray(rng.normal(scale=0.02, size=(d, n)).astype(np.float32))
+    calls = []
+
+    def source(i):
+        calls.append(i)
+        sl = streaming.chunk_slices(f, chunk)[i]
+        return jnp.asarray(stack[sl])
+
+    serial = streaming.streamed_factor_stats(source, 4, rets, prefetch=0)
+    for pf in (1, 3):
+        got = streaming.streamed_factor_stats(source, 4, rets, prefetch=pf)
+        for k in serial:
+            np.testing.assert_array_equal(np.asarray(serial[k]),
+                                          np.asarray(got[k]))
+    assert calls[:4] == [0, 1, 2, 3]  # every run requests chunks in order
+
+    w = np.full((chunk, d), 1.0 / f, np.float32)
+    c0 = streaming.streamed_weighted_composite(source, [w] * 4, prefetch=0)
+    c2 = streaming.streamed_weighted_composite(source, [w] * 4, prefetch=2)
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c2))

@@ -1,0 +1,130 @@
+"""f32/device end-to-end goldens for the demo pipeline.
+
+The CPU suite pins the pipeline on the float64 backend
+(``tests/test_golden_pipeline.py``); device-side f32 numbers were previously
+gated only by ``bench.py``'s invariant asserts. This harness closes that gap:
+
+- ``--record`` runs the demo pipeline in float32 on the CURRENT backend
+  (the real TPU under axon; CPU otherwise) and pins a scalar fingerprint to
+  ``tests/goldens/device_f32.json``.
+- default (check) mode re-runs and compares against the pin with
+  f32-appropriate tolerances — tight for deterministic stages, loose for the
+  QP-backed ones (ADMM in f32 moves with iteration-order changes).
+
+``tests/test_device_goldens.py`` runs the same fingerprint on the CPU backend
+with x64 disabled, so CI catches f32-semantics drift without TPU access;
+re-run ``--record`` on the TPU whenever an intentional numeric change lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PIN_PATH = REPO / "tests" / "goldens" / "device_f32.json"
+
+# demo-pipeline config: identical to tests/test_golden_pipeline.py so the two
+# golden families pin the same workload on different backends/precisions
+N_DATES, N_SYMBOLS, SEED = 60, 24, 777
+WINDOW, DECAY, QP_ITERS = 8, 5, 400
+
+# f32 cross-backend tolerances (CPU f32 vs TPU f32 reassociate differently)
+TOL_DETERMINISTIC = 3e-4   # metrics / equal / linear / icir / momentum
+TOL_QP = 4e-2              # ADMM-backed stages
+
+
+def _load_pipeline_module():
+    spec = importlib.util.spec_from_file_location(
+        "example_pipeline", REPO / "examples" / "pipeline.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def fingerprint(workdir: str | Path | None = None) -> dict:
+    """Run the demo pipeline and reduce it to a flat scalar fingerprint."""
+    mod = _load_pipeline_module()
+    with tempfile.TemporaryDirectory(dir=workdir) as td:
+        td = Path(td)
+        data = mod.make_demo_data(td / "data", n_dates=N_DATES,
+                                  n_symbols=N_SYMBOLS, seed=SEED)
+        out = mod.run_pipeline(data, td / "artifacts", window=WINDOW,
+                               decay=DECAY, qp_iters=QP_ITERS, verbose=False)
+
+    fp: dict = {"deterministic": {}, "qp": {}}
+    m = out["metrics"]
+    for fac in m.index:
+        fp["deterministic"][f"ic/{fac}"] = float(m.loc[fac, "IC"])
+    for label in ("icir", "momentum"):
+        got = out["factor_weights"][label].to_numpy()
+        fp["deterministic"][f"fw_sq/{label}"] = float((got ** 2).sum())
+    for key, (result, _summary) in out["results"].items():
+        total = float(result[0]["log_return"].sum())
+        bucket = "qp" if ("mvo" in key) else "deterministic"
+        fp[bucket][f"logret/{key}"] = total
+    fp["deterministic"]["mm_logret"] = float(
+        out["multimanager"][0]["log_return"].sum())
+    return fp
+
+
+def check(fp: dict, pin: dict) -> list[str]:
+    """Compare a fingerprint to the pin; returns human-readable failures."""
+    fails = []
+    for bucket, tol in (("deterministic", TOL_DETERMINISTIC), ("qp", TOL_QP)):
+        exp, got = pin["values"][bucket], fp[bucket]
+        for key in exp:
+            if key not in got:
+                fails.append(f"missing: {bucket}/{key}")
+            elif abs(got[key] - exp[key]) > tol:
+                fails.append(f"{bucket}/{key}: got {got[key]:.6g}, "
+                             f"pinned {exp[key]:.6g} (tol {tol})")
+        for key in got:
+            if key not in exp:
+                fails.append(f"unpinned new key: {bucket}/{key}")
+    return fails
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--record", action="store_true",
+                        help="write the pin instead of checking it")
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (skip the TPU relay)")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    # f32 everywhere: the device path the CPU suite never exercises
+    jax.config.update("jax_enable_x64", False)
+
+    backend = jax.default_backend()
+    fp = fingerprint()
+    if args.record:
+        PIN_PATH.parent.mkdir(parents=True, exist_ok=True)
+        PIN_PATH.write_text(json.dumps(
+            {"backend": backend,
+             "config": {"n_dates": N_DATES, "n_symbols": N_SYMBOLS,
+                        "seed": SEED, "window": WINDOW, "decay": DECAY,
+                        "qp_iters": QP_ITERS},
+             "values": fp}, indent=2) + "\n")
+        print(f"recorded {PIN_PATH} on backend={backend}")
+        return
+
+    pin = json.loads(PIN_PATH.read_text())
+    fails = check(fp, pin)
+    if fails:
+        raise SystemExit("device goldens FAILED (backend=%s, pin from %s):\n  "
+                         % (backend, pin["backend"]) + "\n  ".join(fails))
+    print(f"device goldens OK (backend={backend}, "
+          f"{len(fp['deterministic']) + len(fp['qp'])} pins, "
+          f"pin recorded on {pin['backend']})")
+
+
+if __name__ == "__main__":
+    main()

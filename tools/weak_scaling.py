@@ -1,0 +1,194 @@
+"""Virtual-device weak-scaling harness for the sharded research step + sweep.
+
+Runs the sharded research step and the combo sweep at 1/2/4/8 virtual CPU
+devices with per-device-CONSTANT shapes (weak scaling: total work grows with
+the mesh), asserts sharded == unsharded at every scale, and writes the
+efficiency table to ``WEAK_SCALING.json`` at the repo root.
+
+Device count is frozen at interpreter start
+(``--xla_force_host_platform_device_count``), so the parent spawns one child
+process per mesh size; each child prints one JSON line.
+
+Reading the numbers on THIS host (a single physical core): the N virtual
+devices time-slice one core, so perfect weak scaling (flat time) is
+impossible — total compute grows ~linearly with the mesh. The honest figure
+is the **work-normalized efficiency** ``(N * t_1) / t_N``: 1.0 means the
+sharded program costs exactly N times the 1-device program (no collective /
+halo-exchange blow-up); values well below 1.0 expose serialization or
+communication overheads that would also tax a real ICI mesh. The
+``sharded_vs_single`` ratio per scale cross-checks the same program against
+its unsharded twin on identical inputs.
+
+Usage::
+
+    python tools/weak_scaling.py            # full 1/2/4/8 ladder + artifact
+    python tools/weak_scaling.py --devices 4   # child mode (internal)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+# per-device workload (weak scaling holds these constant per device)
+F_PER_DEV_SHARD = 8     # factors per factor-shard
+D_PER_DEV_SHARD = 64    # dates per date-shard
+N_ASSETS = 32           # assets (replicated axis)
+C_PER_DEV = 8           # sweep combos per device
+WINDOW = 6
+
+
+def _child(n_devices: int) -> dict:
+    os.environ["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                               f"{n_devices}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from factormodeling_tpu.backtest import SimulationSettings
+    from factormodeling_tpu.parallel import (
+        balanced_mesh_shape,
+        build_research_step,
+        combo_weight_matrix,
+        make_mesh,
+        make_sharded_manager_sweep,
+        make_sharded_research_step,
+        manager_sweep,
+    )
+
+    f_shards, d_shards = balanced_mesh_shape(n_devices)
+    f, d, n = F_PER_DEV_SHARD * f_shards, D_PER_DEV_SHARD * d_shards, N_ASSETS
+    rng = np.random.default_rng(11)
+    factors = rng.normal(size=(f, d, n))
+    factors[rng.uniform(size=factors.shape) < 0.05] = np.nan
+    returns = rng.normal(scale=0.02, size=(d, n))
+    factor_ret = rng.normal(scale=0.01, size=(d, f))
+    cap = rng.integers(1, 4, size=(d, n)).astype(float)
+    invest = np.ones((d, n))
+    universe = np.ones((d, n), dtype=bool)
+    inputs = tuple(jnp.asarray(x) for x in
+                   (factors, returns, factor_ret, cap, invest, universe))
+    names = tuple(f"f{i}_x" for i in range(f))
+    cfg = dict(names=names, window=WINDOW,
+               sim_kwargs=dict(method="equal", pct=0.3))
+
+    def timed(fn, *args, reps=3):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+        return out, min(times)
+
+    # ---- research step: sharded vs single-device twin on the same inputs
+    mesh = make_mesh(("factor", "date"))
+    step, shard_inputs = make_sharded_research_step(mesh, **cfg)
+    sharded_in = shard_inputs(*inputs)
+    sharded_out, t_research = timed(step, *sharded_in)
+    single_out, t_single = timed(jax.jit(build_research_step(**cfg)), *inputs)
+    np.testing.assert_allclose(np.asarray(single_out.selection),
+                               np.asarray(sharded_out.selection), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(single_out.signal),
+                               np.asarray(sharded_out.signal), atol=1e-10,
+                               equal_nan=True)
+    np.testing.assert_allclose(
+        np.asarray(single_out.sim.result.log_return),
+        np.asarray(sharded_out.sim.result.log_return), atol=1e-10,
+        equal_nan=True)
+
+    # ---- combo sweep: combos per device constant
+    c = C_PER_DEV * n_devices
+    combos = rng.integers(0, f, size=(c, 3))
+    cw = combo_weight_matrix(combos, f)
+    settings = SimulationSettings(
+        returns=inputs[1], cap_flag=inputs[3], investability_flag=inputs[4],
+        pct=0.3)
+    combo_mesh = make_mesh(("combo",))
+    sweep = make_sharded_manager_sweep(combo_mesh, combo_batch=4)
+    sw_out, t_sweep = timed(sweep, inputs[0], cw, settings)
+    sg_out, t_sweep_single = timed(
+        jax.jit(lambda fa, w, s: manager_sweep(fa, w, s, combo_batch=4)),
+        inputs[0], cw, settings)
+    np.testing.assert_allclose(np.asarray(sg_out.sharpe),
+                               np.asarray(sw_out.sharpe), atol=1e-8,
+                               equal_nan=True)
+
+    return {
+        "n_devices": n_devices, "mesh": [f_shards, d_shards],
+        "shapes": {"F": f, "D": d, "N": n, "combos": c},
+        "research_step_s": round(t_research, 4),
+        "research_single_s": round(t_single, 4),
+        "sweep_s": round(t_sweep, 4),
+        "sweep_single_s": round(t_sweep_single, 4),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=0,
+                        help="child mode: run one scale and print JSON")
+    parser.add_argument("--ladder", type=int, nargs="*", default=[1, 2, 4, 8])
+    args = parser.parse_args()
+
+    if args.devices:
+        print(json.dumps(_child(args.devices)))
+        return
+
+    rows = []
+    for nd in args.ladder:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        proc = subprocess.run(
+            [sys.executable, __file__, "--devices", str(nd)],
+            capture_output=True, text=True, env=env, cwd=str(REPO))
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            raise SystemExit(f"child for {nd} devices failed")
+        rows.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        print(json.dumps(rows[-1]))
+
+    base = rows[0]
+    table = []
+    for r in rows:
+        nd = r["n_devices"]
+        table.append({
+            **r,
+            # (N * t_1) / t_N: 1.0 = sharding adds no overhead beyond the
+            # N-fold work growth on this single-core host (see module doc)
+            "research_work_norm_eff": round(
+                nd * base["research_step_s"] / r["research_step_s"], 3),
+            "sweep_work_norm_eff": round(
+                nd * base["sweep_s"] / r["sweep_s"], 3),
+            "sharded_vs_single_research": round(
+                r["research_single_s"] / r["research_step_s"], 3),
+            "sharded_vs_single_sweep": round(
+                r["sweep_single_s"] / r["sweep_s"], 3),
+        })
+    artifact = {
+        "host": "single-core CPU, virtual devices (see module docstring for "
+                "how to read work-normalized efficiency)",
+        "per_device_shapes": {"F_per_shard": F_PER_DEV_SHARD,
+                              "D_per_shard": D_PER_DEV_SHARD,
+                              "N": N_ASSETS, "combos_per_device": C_PER_DEV},
+        "rows": table,
+    }
+    out = REPO / "WEAK_SCALING.json"
+    out.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
