@@ -178,7 +178,14 @@ def _risk_model_stack(s: SimulationSettings):
         used = (jnp.arange(lb) < (day - start))[:, None]
         m = _risk.statistical_risk_model(jnp.where(used, rows, jnp.nan),
                                          s.risk_factors)
-        return m.loadings, m.factor_var, m.idio_var
+        # partial-history refits NaN-pad the window to the static lb rows,
+        # but the model's factor variances divide by (lb - 1) regardless —
+        # deflating factor risk by ~used/lb (loadings/idio are per-asset
+        # masked and unaffected). Rescale to the observed-row denominator;
+        # exact: padded-fit * (lb-1)/(used-1) == direct fit on the used rows.
+        n_used = (day - start).astype(m.factor_var.dtype)
+        scale = (lb - 1.0) / jnp.maximum(n_used - 1.0, 1.0)
+        return m.loadings, m.factor_var * scale, m.idio_var
 
     days = (jnp.arange(r) * s.risk_refit_every).astype(jnp.int32)
     stacks = lax.map(fit_one, days, batch_size=min(s.mvo_batch, r))

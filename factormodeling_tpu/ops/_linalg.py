@@ -1,0 +1,44 @@
+"""Batched small-matrix linear algebra without LAPACK custom calls.
+
+TPU lowers ``jnp.linalg.solve`` (and friends) to LU custom calls that
+serialize over the batch — profiled at ~50 ms for 2520 stacked 21x21 systems
+in ``cs_ols`` (the whole einsum feeding them costs ~10 ms). For the F ~ 10-30
+SPD systems this library produces (ridge-regularized normal equations,
+ALS refits), pivot-free Gauss-Jordan elimination vectorized over the batch is
+exact in the same sense (no pivoting needed: callers floor the diagonal) and
+runs as F rank-1 VPU updates — microseconds, fully fused, vmappable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["spd_solve"]
+
+
+def spd_solve(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve ``a @ x = b`` for stacked SPD ``a: [..., F, F]``, ``b: [..., F]``.
+
+    Pivot-free batched Gauss-Jordan over an augmented ``[..., F, F+1]``
+    system: F sequential elimination steps, each a broadcast rank-1 update
+    over the whole batch. Intended for well-conditioned (diagonally
+    regularized) SPD systems with small F; NaN/zero pivots propagate NaN like
+    ``jnp.linalg.solve`` on singular inputs.
+    """
+    f = a.shape[-1]
+    aug = jnp.concatenate([a, b[..., None]], axis=-1)   # [..., F, F+1]
+    rows = jnp.arange(f)
+
+    def step(k, aug):
+        pivrow = lax.dynamic_slice_in_dim(aug, k, 1, axis=-2)   # [..., 1, F+1]
+        pivel = lax.dynamic_slice_in_dim(pivrow, k, 1, axis=-1)  # [..., 1, 1]
+        pivrow = pivrow / pivel
+        colk = lax.dynamic_slice_in_dim(aug, k, 1, axis=-1)      # [..., F, 1]
+        is_k = (rows == k)[..., :, None]
+        fac = jnp.where(is_k, 0.0, colk)
+        aug = aug - fac * pivrow                                  # rank-1
+        return jnp.where(is_k, pivrow, aug)
+
+    aug = lax.fori_loop(0, f, step, aug, unroll=True)
+    return aug[..., -1]

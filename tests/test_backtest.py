@@ -394,3 +394,29 @@ def test_bad_covariance_raises(rng):
     returns, cap, invest, _ = make_market(rng)
     with pytest.raises(ValueError):
         settings_for(returns, cap, invest, method="mvo", covariance="ledoit")
+
+
+def test_risk_model_partial_history_refit_not_deflated(rng):
+    """A refit whose window is only partially filled (NaN-padded to the
+    static risk_lookback) must match the model fit directly on the observed
+    rows — the factor variances carry an observed-row denominator, not the
+    padded one (regression: ~used/lookback deflation)."""
+    from factormodeling_tpu import risk
+    from factormodeling_tpu.backtest.mvo import _risk_model_stack
+
+    d, n, cad, lb = 24, 10, 8, 16
+    returns = rng.normal(scale=0.02, size=(d, n))
+    s = settings_for(returns, np.ones((d, n)), np.ones((d, n)),
+                     method="mvo", covariance="risk_model", risk_factors=3,
+                     risk_lookback=lb, risk_refit_every=cad)
+    loadings_s, fvar_s, idio_s = _risk_model_stack(s)
+    # block 1 refits at day 8 with only 8 of 16 window rows observed
+    direct = risk.statistical_risk_model(jnp.array(returns[:cad]), 3)
+    np.testing.assert_allclose(np.asarray(fvar_s[1]),
+                               np.asarray(direct.factor_var),
+                               rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(np.abs(np.asarray(loadings_s[1])),
+                               np.abs(np.asarray(direct.loadings)),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(idio_s[1]),
+                               np.asarray(direct.idio_var), rtol=1e-6)

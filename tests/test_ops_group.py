@@ -136,3 +136,20 @@ def test_cs_ols_respects_universe(rng):
     y2 = np.where(universe, y, np.nan)
     exp = np.asarray(ops.cs_ols(jnp.array(y2), jnp.array(x)))
     np.testing.assert_allclose(got, exp, atol=1e-12, equal_nan=True)
+
+
+def test_group_ops_broadcast_and_shared_map_agree(rng):
+    """The one-hot dot path (unbroadcast [D, N] map) and the sweep path
+    (map pre-broadcast to the stack's full [F, D, N] rank) must agree; the
+    pre-broadcast form must not crash (regression: the dot-path guard once
+    routed it into a shape error)."""
+    f, d, n, g = 3, 6, 9, 4
+    x = rng.normal(size=(f, d, n))
+    x[rng.uniform(size=x.shape) < 0.1] = np.nan
+    gid = rng.integers(-1, g, size=(d, n)).astype(np.int32)
+    for name in ("group_mean", "group_neutralize", "group_normalize"):
+        op = getattr(ops, name)
+        shared = np.asarray(op(jnp.array(x), jnp.array(gid), g))
+        bcast = np.asarray(op(jnp.array(x),
+                              jnp.broadcast_to(jnp.array(gid), x.shape), g))
+        np.testing.assert_allclose(shared, bcast, atol=1e-9, equal_nan=True)

@@ -48,8 +48,13 @@ WINDOW = 6
 
 
 def _child(n_devices: int) -> dict:
-    os.environ["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
-                               f"{n_devices}")
+    import re
+
+    want = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags, n_sub = re.subn(
+        r"--xla_force_host_platform_device_count=\d+", want, flags)
+    os.environ["XLA_FLAGS"] = flags.strip() if n_sub else f"{flags} {want}".strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
