@@ -73,10 +73,16 @@ def plot_full_performance(analyzer, counts=None):
     ax_main.set_title("Cumulative Return (Total / Long / Short) with Monthly Bars")
     ax_main.legend(loc="upper left")
     ax_main.grid(True)
+    # percent axes, like the reference (portfolio_analyzer.py:154,160)
+    import matplotlib.ticker as mtick
+
+    ax_main.yaxis.set_major_formatter(mtick.PercentFormatter(xmax=1.0))
     months, mret = analyzer.monthly_return()
     ax_ret.bar(months.astype("datetime64[ns]"), mret, width=20,
                color=["green" if v >= 0 else "red" for v in mret], alpha=0.4)
     ax_ret.set_ylabel("Monthly Return", color="gray")
+    ax_ret.tick_params(axis="y", labelcolor="gray")
+    ax_ret.yaxis.set_major_formatter(mtick.PercentFormatter(xmax=1.0))
 
     # rolling MAs of daily returns
     ax_ma = fig.add_subplot(gs[2, :], sharex=ax_main)
@@ -87,6 +93,12 @@ def plot_full_performance(analyzer, counts=None):
     ax_ma.set_title("Rolling MA of Daily Returns")
     ax_ma.legend(loc="upper left")
     ax_ma.grid(True)
+    # percent y-axis + year ticks (portfolio_analyzer.py:185-190)
+    import matplotlib.dates as mdates
+
+    ax_ma.yaxis.set_major_formatter(mtick.PercentFormatter(xmax=1.0))
+    ax_ma.xaxis.set_major_locator(mdates.YearLocator())
+    ax_ma.xaxis.set_major_formatter(mdates.DateFormatter("%Y"))
 
     row = 3
     if has_turnover:

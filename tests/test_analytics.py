@@ -99,6 +99,20 @@ def test_plots_render_headless(rng, tmp_path):
     fig = plot_full_performance(a, counts)
     fig.savefig(tmp_path / "dash.png")
 
+    # cosmetic parity with the reference dashboard: percent y-axes on the
+    # cumulative/monthly/MA panels and year ticks on the MA panel
+    # (portfolio_analyzer.py:154,160,185-190)
+    import matplotlib.dates as mdates
+    import matplotlib.ticker as mtick
+
+    axes = fig.get_axes()
+    pct_axes = [ax for ax in axes
+                if isinstance(ax.yaxis.get_major_formatter(),
+                              mtick.PercentFormatter)]
+    assert len(pct_axes) >= 3
+    assert any(isinstance(ax.xaxis.get_major_locator(), mdates.YearLocator)
+               for ax in axes)
+
     factors = rng.normal(size=(4, 20, 30))
     fig2 = plot_factor_distributions(factors, [f"f{i}" for i in range(4)])
     fig2.savefig(tmp_path / "dist.png")
