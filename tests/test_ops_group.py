@@ -153,3 +153,21 @@ def test_group_ops_broadcast_and_shared_map_agree(rng):
         bcast = np.asarray(op(jnp.array(x),
                               jnp.broadcast_to(jnp.array(gid), x.shape), g))
         np.testing.assert_allclose(shared, bcast, atol=1e-9, equal_nan=True)
+
+
+def test_group_ops_beyond_dot_path_group_limit(rng):
+    """num_groups > 128 must fall back to the fori_loop sweep path and still
+    match the oracle (guard-boundary regression for the one-hot dot
+    dispatch)."""
+    d, n, g = 4, 300, 140
+    x = rng.normal(size=(d, n))
+    x[rng.uniform(size=x.shape) < 0.1] = np.nan
+    gid = rng.integers(-1, g, size=(d, n)).astype(np.int32)
+    got = np.asarray(ops.group_mean(jnp.array(x), jnp.array(gid), g))
+    import pandas as pd
+
+    s = po.dense_to_long(x)
+    grp = pd.Series([f"g{v}" if v >= 0 else np.nan for v in gid.ravel()],
+                    index=s.index)
+    exp = po.long_to_dense(po.o_group_mean(s, grp), d, n)
+    np.testing.assert_allclose(got, exp, atol=1e-9, equal_nan=True)

@@ -169,3 +169,22 @@ def test_unrolled_segment_path_matches_rolled(rng, monkeypatch):
                                       np.asarray(unrolled.x))
         np.testing.assert_array_equal(float(rolled.primal_residual),
                                       float(unrolled.primal_residual))
+
+
+def test_spd_solve_matches_numpy_and_propagates_nan(rng):
+    """The custom-call-free batched Gauss-Jordan solve (ops/_linalg) must
+    match numpy on well-conditioned SPD batches and propagate NaN on
+    singular inputs like jnp.linalg.solve."""
+    from factormodeling_tpu.ops._linalg import spd_solve
+
+    b, f = 7, 9
+    a = rng.normal(size=(b, f, f))
+    a = a @ np.swapaxes(a, -1, -2) + 0.5 * np.eye(f)
+    y = rng.normal(size=(b, f))
+    got = np.asarray(spd_solve(jnp.array(a), jnp.array(y)))
+    exp = np.linalg.solve(a, y[..., None])[..., 0]
+    np.testing.assert_allclose(got, exp, rtol=1e-9, atol=1e-12)
+
+    sing = np.zeros((1, 3, 3))
+    out = np.asarray(spd_solve(jnp.array(sing), jnp.ones((1, 3))))
+    assert np.isnan(out).all()
