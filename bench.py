@@ -246,18 +246,17 @@ def bench_composite_ops(smoke=False, profile=False):
     groups = rng.integers(0, g, size=(d, n)).astype(np.int32)
 
     sd, gd = jnp.asarray(stack), jnp.asarray(groups)
-    # the [D, N] industry map is shared across factors — pass it unbroadcast
-    # so the kernel takes the one-hot MXU dot path
-    step = jax.jit(lambda s, grp: ops.group_neutralize(
-        ops.cs_zscore(s), grp, g))
+    # the public chain API on its default path: the XLA composition whose
+    # group stage rides the one-hot MXU dots (the opt-in Pallas fusion
+    # measured at parity on v5e — see ops/_pallas_fused.py)
+    step = jax.jit(lambda s, grp: ops.cs_zscore_group_neutralize(s, grp, g))
 
     # pipelined throughput (chained data dependency), like rank_ic/cs_ols:
-    # the op chain is ~21 ms of device time; a lone call adds ~60 ms of
-    # relay round trip
+    # a lone call adds ~60 ms of relay round trip
     reps = 2 if smoke else 10
     chained_step = jax.jit(
-        lambda s, grp, prev: ops.group_neutralize(
-            ops.cs_zscore(s + 0.0 * jnp.nan_to_num(prev)), grp, g))
+        lambda s, grp, prev: ops.cs_zscore_group_neutralize(
+            s + 0.0 * jnp.nan_to_num(prev), grp, g))
 
     def chained():
         prev = jnp.zeros((), sd.dtype)

@@ -22,6 +22,7 @@ from factormodeling_tpu.ops.cross_sectional import (  # noqa: F401
 from factormodeling_tpu.ops.elementwise import abs_, clip, log, power, sign  # noqa: F401
 from factormodeling_tpu.ops.group import (  # noqa: F401
     bucket,
+    cs_zscore_group_neutralize,
     group_mean,
     group_neutralize,
     group_normalize,
