@@ -62,6 +62,13 @@ class SimulationSettings:
     # device-solver knobs (compat extras with safe defaults)
     qp_iters: int = 500
     mvo_batch: int = 32
+    # MVO covariance source (compat extra; the reference is sample-only):
+    # "risk_model" swaps the trailing sample window for a rolling
+    # statistical factor model (see backtest/settings.py)
+    covariance: str = "sample"
+    risk_factors: int = 10
+    risk_lookback: int = 252
+    risk_refit_every: int = 21
 
 
 class Simulation:
@@ -97,7 +104,10 @@ class Simulation:
             shrinkage_intensity=self.shrinkage_intensity,
             turnover_penalty=self.turnover_penalty,
             return_weight=self.return_weight,
-            qp_iters=self.qp_iters, mvo_batch=self.mvo_batch)
+            qp_iters=self.qp_iters, mvo_batch=self.mvo_batch,
+            covariance=self.covariance, risk_factors=self.risk_factors,
+            risk_lookback=self.risk_lookback,
+            risk_refit_every=self.risk_refit_every)
 
     def _signal_dense(self):
         sig, uni = self._vocab.densify(self.custom_feature)

@@ -65,3 +65,30 @@ def test_install_is_idempotent():
         assert set(first) == set(compat.REFERENCE_MODULES)
     finally:
         compat.uninstall()
+
+
+def test_compat_simulation_risk_model_covariance(rng):
+    """The compat Simulation forwards the risk-model covariance extras to the
+    dense engine (a compat-side extension; the reference is sample-only)."""
+    import numpy as np
+    import pandas as pd
+
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation,
+        SimulationSettings,
+    )
+    from tests import pandas_oracle as po
+
+    d, n = 30, 10
+    rets = po.dense_to_long(rng.normal(scale=0.02, size=(d, n)))
+    cap = po.dense_to_long(rng.integers(1, 4, size=(d, n)).astype(float))
+    inv = po.dense_to_long(np.ones((d, n)))
+    sig = po.dense_to_long(rng.normal(size=(d, n)))
+    settings = SimulationSettings(
+        returns=rets, cap_flag=cap, investability_flag=inv,
+        factors_df=None, method="mvo", plot=False, output_returns=True,
+        max_weight=0.5, lookback_period=6, qp_iters=60,
+        covariance="risk_model", risk_factors=2, risk_lookback=8,
+        risk_refit_every=8)
+    out = Simulation("rm", sig.rename("custom_feature"), settings).run()
+    assert np.isfinite(out["log_return"].to_numpy(dtype=float)).all()
