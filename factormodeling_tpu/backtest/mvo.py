@@ -127,7 +127,8 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     # be 2 Sigma for the trade-off against the L1/return terms to match.
     prob = BoxQPProblem(q=q, lo=lo, hi=hi, E=E, b=b, l1=l1, center=center)
     res = admm_solve_lowrank(2.0 * alpha, c, 2.0 * s_vec, prob,
-                             rho=s.qp_rho, iters=s.qp_iters)
+                             rho=s.qp_rho,
+                             iters=s.resolved_qp_iters(turnover))
     w = res.x
 
     solver_ok = (jnp.all(jnp.isfinite(w))
@@ -250,6 +251,17 @@ def _universe_count(signal: jnp.ndarray, s: SimulationSettings):
     return jnp.full(signal.shape[:-1], signal.shape[-1])
 
 
+def _no_hist_days(d: int, s: SimulationSettings):
+    """Days whose solve falls to the equal-scheme ladder for lack of history:
+    day 0 under the trailing sample window; the whole first refit block under
+    the risk model (block 0's model is fit on zero rows, so ``_solve_day``
+    sees ``t_used == 0`` for every day before the first refit)."""
+    days = jnp.arange(d)
+    if s.covariance == "risk_model":
+        return days < s.risk_refit_every
+    return days == 0
+
+
 def _finalize(w, signal, s, pos, neg, flat, resid, ok):
     zero_day = flat | (_universe_count(signal, s) < 2)
     w = jnp.where(zero_day[..., None], 0.0, w)
@@ -257,8 +269,8 @@ def _finalize(w, signal, s, pos, neg, flat, resid, ok):
     lc = pos.sum(-1)
     sc = neg.sum(-1)
     # no-history days fall back to the equal scheme and report its k counts
-    # (portfolio_simulation.py:188-190) — with a dense date axis that is day 0.
-    no_hist = jnp.arange(signal.shape[0]) == 0
+    # (portfolio_simulation.py:188-190)
+    no_hist = _no_hist_days(signal.shape[0], s)
     k_long = jnp.maximum(jnp.floor(lc * s.pct), 1.0).astype(lc.dtype)
     k_short = jnp.maximum(jnp.floor(sc * s.pct), 1.0).astype(sc.dtype)
     lc = jnp.where(no_hist, k_long, lc)

@@ -59,10 +59,20 @@ class SimulationSettings:
     risk_lookback: int = dataclasses.field(default=252, metadata=dict(static=True))
     risk_refit_every: int = dataclasses.field(default=21, metadata=dict(static=True))
 
-    # ADMM solver knobs (device-side replacement for OSQP/SLSQP)
-    qp_iters: int = dataclasses.field(default=500, metadata=dict(static=True))
+    # ADMM solver knobs (device-side replacement for OSQP/SLSQP).
+    # ``qp_iters=None`` resolves per scheme: 500 for plain mvo, 100 for
+    # mvo_turnover — mirroring the reference's OSQP budgets (max_iter=2000
+    # vs the deliberate max_iter=100 turnover quirk,
+    # portfolio_simulation.py:427-437,486-501) so the default config solves
+    # what the published headline number measures.
+    qp_iters: int | None = dataclasses.field(default=None, metadata=dict(static=True))
     qp_rho: float = dataclasses.field(default=2.0, metadata=dict(static=True))
     mvo_batch: int = dataclasses.field(default=32, metadata=dict(static=True))
+
+    def resolved_qp_iters(self, turnover: bool) -> int:
+        if self.qp_iters is not None:
+            return self.qp_iters
+        return 100 if turnover else 500
 
     def __post_init__(self):
         if self.method not in ("equal", "linear", "mvo", "mvo_turnover"):

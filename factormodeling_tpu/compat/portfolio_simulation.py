@@ -59,8 +59,10 @@ class SimulationSettings:
     shrinkage_intensity: float = 0.1
     turnover_penalty: float = 0.1
     return_weight: float = 0.0
-    # device-solver knobs (compat extras with safe defaults)
-    qp_iters: int = 500
+    # device-solver knobs (compat extras with safe defaults); qp_iters=None
+    # resolves per scheme (500 mvo / 100 mvo_turnover) like the reference's
+    # OSQP max_iter budgets (portfolio_simulation.py:427-437,486-501)
+    qp_iters: int | None = None
     mvo_batch: int = 32
     # MVO covariance source (compat extra; the reference is sample-only):
     # "risk_model" swaps the trailing sample window for a rolling

@@ -29,6 +29,10 @@ __all__ = [
 
 _ASSET_AXIS = -1
 
+# Peak bytes allowed for one one-hot slab in _segment_sums_dot; bounds HBM as
+# the group count approaches the 128-group dot-path cap.
+_ONEHOT_SLAB_BYTES = 256 * 1024 * 1024
+
 
 def bucket(x: jnp.ndarray, bin_range=(0.2, 1.0, 0.2)) -> jnp.ndarray:
     """Fixed-bin bucketing into int ids 0..k-1 (-1 = NaN / out of range).
@@ -70,10 +74,6 @@ def _segment_sums_dot(x: jnp.ndarray, gids: jnp.ndarray, num_groups: int):
     valid = ~jnp.isnan(xb)
     x0 = jnp.where(valid, xb, 0.0)
     vf = valid.astype(x.dtype)
-    # ids < 0 match no group -> zero one-hot row, so out-of-group cells drop
-    # out of every sum and scatter back count 0 with no extra masking
-    onehot = (gb[..., None]
-              == jnp.arange(num_groups, dtype=jnp.int32)).astype(x.dtype)
     from jax import lax
 
     # two dots, not one concatenated [2R, B, N] operand — XLA materializes a
@@ -84,12 +84,26 @@ def _segment_sums_dot(x: jnp.ndarray, gids: jnp.ndarray, num_groups: int):
     # emulation costs little.
     dims = (((2,), (1,)), ((1,), (0,)))
     hi = lax.Precision.HIGHEST
-    sums_x = lax.dot_general(x0, onehot, dims, precision=hi)  # [B, R, G]
-    sums_c = lax.dot_general(vf, onehot, dims, precision=hi)  # [B, R, G]
-    sums = jnp.concatenate([sums_x, sums_c], axis=1)          # [B, 2R, G] tiny
-    cells = lax.dot_general(sums, onehot,
-                            (((2,), (2,)), ((0,), (0,))),
-                            precision=hi)                     # [B, 2R, N]
+    # The one-hot is the only G-proportional buffer: [B, N, gc] f32 per slab.
+    # A full-width [B, N, 128] one-hot on the [1260, 3000] bench panel would
+    # be ~1.9 GB of HBM, so the group axis is sliced into slabs capped at
+    # _ONEHOT_SLAB_BYTES; each cell belongs to exactly one group, so slab
+    # scatter-back dots sum disjointly. Typical G (~11 industries) fits one
+    # slab and compiles to exactly the unchunked program.
+    gc = max(1, int(_ONEHOT_SLAB_BYTES // max(x.dtype.itemsize * d * n, 1)))
+    cells = None
+    for g0 in range(0, num_groups, gc):
+        # ids < 0 match no group -> zero one-hot row, so out-of-group cells
+        # drop out of every sum and scatter back count 0 with no extra masking
+        ids = jnp.arange(g0, min(g0 + gc, num_groups), dtype=jnp.int32)
+        onehot = (gb[..., None] == ids).astype(x.dtype)
+        sums_x = lax.dot_general(x0, onehot, dims, precision=hi)  # [B, R, gc]
+        sums_c = lax.dot_general(vf, onehot, dims, precision=hi)  # [B, R, gc]
+        sums = jnp.concatenate([sums_x, sums_c], axis=1)          # [B, 2R, gc]
+        part = lax.dot_general(sums, onehot,
+                               (((2,), (2,)), ((0,), (0,))),
+                               precision=hi)                      # [B, 2R, N]
+        cells = part if cells is None else cells + part
     sum_cell = jnp.moveaxis(cells[:, :r], 0, 1).reshape(x.shape)
     cnt_cell = jnp.moveaxis(cells[:, r:], 0, 1).reshape(x.shape)
     in_group = jnp.broadcast_to((gb >= 0).reshape(bshape + (n,)), x.shape)

@@ -414,7 +414,8 @@ def test_compat_decay_sensitivity_matches_per_window_loop(rng, tmp_path):
         feat = cop.ts_decay(signal, w).rename("custom_feature")
         result = Simulation(f"decay_{w}", feat, settings()).run()
         daily_r = result.sort_values("date")["log_return"].to_numpy()
-        annret = np.prod(1 + daily_r) ** (252 / len(daily_r)) - 1
+        with np.errstate(invalid="ignore"):  # NaN edge: fractional power of NaN prod
+            annret = np.prod(1 + daily_r) ** (252 / len(daily_r)) - 1
         sharpe = (daily_r.mean() / daily_r.std(ddof=1)) * np.sqrt(252)
         np.testing.assert_allclose(got.loc[w, "annualized_return"], annret,
                                    rtol=1e-5)
