@@ -1,0 +1,390 @@
+"""Differential parity: the reference's OWN code is the oracle.
+
+The modules under ``/root/reference`` are imported directly — with stub
+modules standing in for their import-time-only dependencies (``operations.py``
+imports ``statsmodels.api`` but never calls it; ``portfolio_simulation.py``
+and ``factor_selection_methods.py`` import ``cvxpy``, which only the
+mvo paths touch) — and executed on shared synthetic pandas panels. The compat
+layer must reproduce their outputs at 1e-8 (both sides run float64: conftest
+enables jax x64).
+
+This retires the hand-written ``tests/pandas_oracle.py`` as the only evidence
+for these paths (round-3 verdict, Missing #1): a re-derived oracle can share a
+bug with the kernels; the reference itself cannot.
+
+Covered here, each against ``/root/reference``'s namesake:
+- every op in ``operations.py:1-304``
+- ``single_factor_metrics`` + rolling ``FactorSelector`` (``factor_selector.py:26-139``)
+- ``composite_factor_calculation`` / ``weighted_composite_factor``
+  (``composite_factor.py:137-342``)
+- equal/linear ``Simulation`` weights + result frames
+  (``portfolio_simulation.py:96-181,748-797``)
+- ``run_multimanager_backtest`` (``multi_manager.py:32-100``)
+
+The mvo/mvo_turnover schemes and the mvo selector need a real QP solver on the
+reference side (cvxpy/OSQP, not installed here); their parity evidence is the
+committed OSQP-algorithm goldens in ``tests/test_qp_goldens.py``.
+"""
+
+import importlib
+import sys
+import types
+from types import SimpleNamespace
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REFERENCE_DIR = "/root/reference"
+REF_MODULES = (
+    "operations",
+    "factor_selection_methods",
+    "factor_selector",
+    "portfolio_analyzer",
+    "portfolio_simulation",
+    "composite_factor",
+    "multi_manager",
+)
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """Import the reference modules directly, stubbing import-time-only deps,
+    then restore ``sys.modules`` so the compat shims' bare-name installs
+    (``compat.install``) are unaffected by this module."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+
+    saved = sys.modules.copy()
+    sm = types.ModuleType("statsmodels")
+    sm_api = types.ModuleType("statsmodels.api")
+    sm_api.OLS = object  # imported at operations.py:3, never called
+    sm_api.add_constant = object
+    sm.api = sm_api
+    cvxpy_stub = types.ModuleType("cvxpy")  # only the (untested) mvo paths call it
+
+    for name in REF_MODULES:
+        sys.modules.pop(name, None)
+    sys.modules["statsmodels"] = sm
+    sys.modules["statsmodels.api"] = sm_api
+    sys.modules["cvxpy"] = cvxpy_stub
+    sys.path.insert(0, REFERENCE_DIR)
+    importlib.invalidate_caches()
+    try:
+        mods = {name: importlib.import_module(name) for name in REF_MODULES}
+    finally:
+        sys.path.remove(REFERENCE_DIR)
+        for k in list(sys.modules):
+            if k not in saved:
+                del sys.modules[k]
+        sys.modules.update(saved)
+    return SimpleNamespace(**mods)
+
+
+@pytest.fixture(scope="module")
+def compat():
+    mods = {name: importlib.import_module(f"factormodeling_tpu.compat.{name}")
+            for name in ("operations", "factor_selector", "composite_factor",
+                         "portfolio_simulation", "multi_manager")}
+    return SimpleNamespace(**mods)
+
+
+# ----------------------------------------------------------------- test data
+
+D, N = 26, 14
+FACTOR_NAMES = ("alpha_eq", "alpha_flx", "beta_long", "beta_short",
+                "gamma_eq", "gamma_flx")
+
+
+def _index(d=D, n=N):
+    dates = pd.date_range("2021-01-04", periods=d, freq="B")
+    symbols = [f"S{i:03d}" for i in range(n)]
+    return pd.MultiIndex.from_product([dates, symbols], names=["date", "symbol"])
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(20260731)
+    idx = _index()
+    x = pd.Series(rng.normal(size=len(idx)), index=idx, name="x")
+    x[rng.uniform(size=len(idx)) < 0.06] = np.nan
+    y = pd.Series(rng.normal(size=len(idx)), index=idx, name="y")
+    y[rng.uniform(size=len(idx)) < 0.06] = np.nan
+    groups = pd.Series(
+        rng.choice(["tech", "fin", "health"], size=len(idx)), index=idx)
+    groups[rng.uniform(size=len(idx)) < 0.04] = np.nan
+    returns = pd.Series(rng.normal(scale=0.02, size=len(idx)), index=idx,
+                        name="log_return")
+    returns[rng.uniform(size=len(idx)) < 0.02] = np.nan
+    cap = pd.Series(rng.integers(1, 4, size=len(idx)).astype(float), index=idx,
+                    name="cap_flag")
+    invest = pd.Series(1.0, index=idx, name="investability_flag")
+    factors = pd.DataFrame(
+        {name: rng.normal(size=len(idx)) for name in FACTOR_NAMES}, index=idx)
+    for name in FACTOR_NAMES:
+        col = factors[name].to_numpy().copy()
+        col[rng.uniform(size=len(idx)) < 0.05] = np.nan
+        factors[name] = col
+    factor_ret = pd.DataFrame(
+        rng.normal(scale=0.01, size=(D, len(FACTOR_NAMES))),
+        index=_index().get_level_values("date").unique(),
+        columns=list(FACTOR_NAMES))
+    return SimpleNamespace(x=x, y=y, groups=groups, returns=returns, cap=cap,
+                           invest=invest, factors=factors,
+                           factor_ret=factor_ret)
+
+
+def assert_series_match(got: pd.Series, exp: pd.Series, atol=1e-8, what=""):
+    got, exp = got.sort_index(), exp.sort_index()
+    pd.testing.assert_index_equal(got.index, exp.index, exact=False)
+    np.testing.assert_allclose(got.to_numpy(dtype=float),
+                               exp.to_numpy(dtype=float),
+                               atol=atol, rtol=0, equal_nan=True, err_msg=what)
+
+
+# ------------------------------------------------------------ operations.py
+
+TS_OPS = ["ts_sum", "ts_mean", "ts_std", "ts_zscore", "ts_rank", "ts_diff",
+          "ts_delay", "ts_decay"]
+
+
+@pytest.mark.parametrize("op", TS_OPS)
+@pytest.mark.parametrize("window", [3, 7])
+def test_ts_ops_match_reference(ref, compat, data, op, window):
+    exp = getattr(ref.operations, op)(data.x, window)
+    got = getattr(compat.operations, op)(data.x, window)
+    assert_series_match(got, exp, what=f"{op} w={window}")
+
+
+def test_ts_backfill_matches_reference(ref, compat, data):
+    assert_series_match(compat.operations.ts_backfill(data.x),
+                        ref.operations.ts_backfill(data.x))
+
+
+def test_ts_decay_identity_window_matches_reference(ref, compat, data):
+    # window < 1 -> identity passthrough (operations.py:41-42)
+    assert_series_match(compat.operations.ts_decay(data.x, 0),
+                        ref.operations.ts_decay(data.x, 0))
+
+
+@pytest.mark.parametrize("method", ["average", "min", "max", "first", "dense"])
+def test_cs_rank_matches_reference(ref, compat, data, method):
+    assert_series_match(compat.operations.cs_rank(data.x, method=method),
+                        ref.operations.cs_rank(data.x, method=method),
+                        what=f"cs_rank {method}")
+
+
+@pytest.mark.parametrize("op,kwargs", [
+    ("cs_winsor", {"limits": (0.01, 0.99)}),
+    ("cs_winsor", {"limits": (0.1, 0.9)}),
+    ("cs_filter_center", {"center": (0.3, 0.7)}),
+    ("cs_zscore", {}),
+    ("cs_mean", {}),
+    ("market_neutralize", {}),
+])
+def test_cs_ops_match_reference(ref, compat, data, op, kwargs):
+    exp = getattr(ref.operations, op)(data.x, **kwargs)
+    got = getattr(compat.operations, op)(data.x, **kwargs)
+    assert_series_match(got, exp, what=op)
+
+
+def test_cs_bool_and_elementwise_match_reference(ref, compat, data):
+    cond = data.x > 0
+    assert_series_match(compat.operations.cs_bool(cond, 2.0, -1.0),
+                        ref.operations.cs_bool(cond, 2.0, -1.0))
+    assert_series_match(compat.operations.sign(data.x),
+                        ref.operations.sign(data.x))
+    assert_series_match(compat.operations.power(data.x, 2.0),
+                        ref.operations.power(data.x, 2.0))
+    pos = data.x.abs() + 0.5
+    assert_series_match(compat.operations.log(pos), ref.operations.log(pos))
+    assert_series_match(compat.operations.abs_(data.x),
+                        ref.operations.abs_(data.x))
+    assert_series_match(compat.operations.clip(data.x, -0.7, 0.7),
+                        ref.operations.clip(data.x, -0.7, 0.7))
+
+
+def test_bucket_matches_reference(ref, compat, data):
+    # [0, 1] values so most land inside the reference bin range
+    vals = data.x.rank(pct=True)
+    exp = ref.operations.bucket(vals).astype(object)
+    got = compat.operations.bucket(vals).astype(object)
+    exp_al, got_al = exp.sort_index(), got.sort_index()
+    pd.testing.assert_index_equal(got_al.index, exp_al.index, exact=False)
+    assert (got_al.isna() == exp_al.isna()).all()
+    m = ~exp_al.isna()
+    assert (got_al[m].astype(str) == exp_al[m].astype(str)).all()
+
+
+GROUP_OPS = ["group_mean", "group_neutralize", "group_normalize",
+             "group_rank_normalized"]
+
+
+@pytest.mark.parametrize("op", GROUP_OPS)
+def test_group_ops_match_reference(ref, compat, data, op):
+    exp = getattr(ref.operations, op)(data.x, data.groups)
+    got = getattr(compat.operations, op)(data.x, data.groups)
+    assert_series_match(got, exp, what=op)
+
+
+@pytest.mark.parametrize("rettype", [0, 1, 2, 3, 6])
+def test_ts_regression_fast_matches_reference(ref, compat, data, rettype):
+    # lag=0 only: compat's lag shifts x per symbol, a documented deliberate
+    # fix of the reference's positional long-frame shift (operations.py:203),
+    # which leaks the previous symbol's value across symbols within a date.
+    exp = ref.operations.ts_regression_fast(data.y, data.x, window=6,
+                                            rettype=rettype)
+    got = compat.operations.ts_regression_fast(data.y, data.x, window=6,
+                                               rettype=rettype)
+    # the reference emits only the defined entries (per-symbol dropna concat,
+    # operations.py:244-246); compat aligns to y.index with NaN elsewhere —
+    # pandas arithmetic/dropna treat the two identically downstream
+    assert_series_match(got.dropna(), exp.dropna(),
+                        what=f"ts_regression rettype={rettype}")
+    extra = got[~got.index.isin(exp.index)]
+    assert extra.isna().all()
+
+
+@pytest.mark.parametrize("rettype", ["resid", "beta", "alpha", "fitted", "r2"])
+def test_cs_regression_matches_reference(ref, compat, data, rettype):
+    exp = ref.operations.cs_regression(data.y, data.x, rettype=rettype)
+    got = compat.operations.cs_regression(data.y, data.x, rettype=rettype)
+    assert_series_match(got, exp, what=f"cs_regression {rettype}")
+
+
+# -------------------------------------------------------- factor_selector.py
+
+def test_single_factor_metrics_matches_reference(ref, compat, data):
+    exp = ref.factor_selector.single_factor_metrics(data.factors, data.returns)
+    got = compat.factor_selector.single_factor_metrics(data.factors,
+                                                       data.returns)
+    assert list(got.index) == list(exp.index)  # same rank_IC_IR sort order
+    assert list(got.columns) == list(exp.columns)
+    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy(), atol=1e-8,
+                               rtol=1e-8, equal_nan=True)
+
+
+@pytest.mark.parametrize("method,kwargs", [
+    ("icir_top", {"icir_threshold": 0.0, "top_x": 3}),
+    ("momentum", {"max_weight": 0.6}),
+])
+def test_factor_selector_matches_reference(ref, compat, data, method, kwargs):
+    window = 6
+    exp = ref.factor_selector.FactorSelector(
+        data.factors, data.returns, data.factor_ret, window, method,
+        method_kwargs=dict(kwargs)).prepare_selection()
+    got = compat.factor_selector.FactorSelector(
+        data.factors, data.returns, data.factor_ret, window, method,
+        method_kwargs=dict(kwargs)).prepare_selection()
+    assert list(got.index) == list(exp.index)
+    got = got[exp.columns]
+    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy(), atol=1e-8,
+                               rtol=0, err_msg=method)
+
+
+# ------------------------------------------------------- composite_factor.py
+
+@pytest.mark.parametrize("method", ["zscore", "rank"])
+def test_composite_static_matches_reference(ref, compat, data, method):
+    exp = ref.composite_factor.composite_factor_calculation(
+        data.factors, list(FACTOR_NAMES), method=method)
+    got = compat.composite_factor.composite_factor_calculation(
+        data.factors, list(FACTOR_NAMES), method=method)
+    assert_series_match(got, exp, what=f"composite {method}")
+
+
+@pytest.mark.parametrize("method", ["zscore", "rank"])
+def test_weighted_composite_matches_reference(ref, compat, data, method):
+    rng = np.random.default_rng(5)
+    dates = data.factors.index.get_level_values("date").unique()
+    sel = pd.DataFrame(rng.uniform(size=(len(dates), len(FACTOR_NAMES))),
+                       index=dates, columns=list(FACTOR_NAMES))
+    sel[sel < 0.35] = 0.0  # zero weights drop factors that day (:281)
+    sel = sel.div(sel.sum(axis=1).replace(0, np.nan), axis=0).fillna(0.0)
+    exp = ref.composite_factor.weighted_composite_factor(data.factors, sel,
+                                                         method=method)
+    got = compat.composite_factor.weighted_composite_factor(data.factors, sel,
+                                                            method=method)
+    assert_series_match(got, exp, what=f"weighted composite {method}")
+
+
+# --------------------------------------------------- portfolio_simulation.py
+
+def _settings(mod, data, method, **kw):
+    return mod.SimulationSettings(
+        returns=data.returns, cap_flag=data.cap, investability_flag=data.invest,
+        factors_df=pd.DataFrame(index=data.returns.index), method=method,
+        pct=0.3, max_weight=0.35, plot=False, output_returns=True, **kw)
+
+
+@pytest.mark.parametrize("method", ["equal", "linear"])
+def test_simulation_matches_reference(ref, compat, data, method):
+    signal = (data.factors["alpha_flx"] - data.factors["alpha_flx"]
+              .groupby(level="date").transform("mean")).rename("sig")
+    exp_sim = ref.portfolio_simulation.Simulation(
+        "diff", signal.copy(), _settings(ref.portfolio_simulation, data, method))
+    got_sim = compat.portfolio_simulation.Simulation(
+        "diff", signal.copy(), _settings(compat.portfolio_simulation, data, method))
+
+    exp_w, exp_counts = exp_sim._daily_trade_list()
+    got_w, got_counts = got_sim._daily_trade_list()
+    assert_series_match(got_w.rename("w"), exp_w.rename("w"),
+                        what=f"{method} weights")
+    pd.testing.assert_index_equal(got_counts.index, exp_counts.index,
+                                  exact=False)
+    np.testing.assert_array_equal(
+        got_counts[["long_count", "short_count"]].to_numpy(),
+        exp_counts[["long_count", "short_count"]].to_numpy())
+
+    exp_res = exp_sim._daily_portfolio_returns(exp_w)[0]
+    got_res = got_sim._daily_portfolio_returns(got_w)[0]
+    for col in ["log_return", "long_return", "short_return", "long_turnover",
+                "short_turnover", "turnover"]:
+        np.testing.assert_allclose(
+            got_res.sort_values("date")[col].to_numpy(),
+            exp_res.sort_values("date")[col].to_numpy(),
+            atol=1e-8, rtol=0, equal_nan=True, err_msg=f"{method}:{col}")
+
+
+def test_simulation_run_result_matches_reference(ref, compat, data):
+    signal = data.factors["gamma_flx"].rename("sig")
+    exp = ref.portfolio_simulation.Simulation(
+        "runparity", signal.copy(),
+        _settings(ref.portfolio_simulation, data, "equal")).run()
+    got = compat.portfolio_simulation.Simulation(
+        "runparity", signal.copy(),
+        _settings(compat.portfolio_simulation, data, "equal")).run()
+    np.testing.assert_allclose(
+        got.sort_values("date")["log_return"].to_numpy(),
+        exp.sort_values("date")["log_return"].to_numpy(),
+        atol=1e-8, rtol=0, equal_nan=True)
+
+
+# --------------------------------------------------------- multi_manager.py
+
+def test_multimanager_matches_reference(ref, compat, data):
+    fw_names = ["alpha_flx", "beta_long", "gamma_eq"]
+    dates = data.factors.index.get_level_values("date").unique()
+    rng = np.random.default_rng(9)
+    fw = pd.DataFrame(rng.uniform(size=(len(dates), len(fw_names))),
+                      index=dates, columns=fw_names)
+    fw = fw.div(fw.sum(axis=1), axis=0)
+
+    exp = ref.multi_manager.run_multimanager_backtest(
+        data.factors, data.returns, data.cap, fw,
+        _settings(ref.portfolio_simulation, data, "equal"))
+    got = compat.multi_manager.run_multimanager_backtest(
+        data.factors, data.returns, data.cap, fw,
+        _settings(compat.portfolio_simulation, data, "equal"))
+    exp_res, got_res = exp[0], got[0]
+    np.testing.assert_allclose(
+        got_res.sort_values("date")["log_return"].to_numpy(),
+        exp_res.sort_values("date")["log_return"].to_numpy(),
+        atol=1e-8, rtol=0, equal_nan=True)
+    # weighted counts frame (multi_manager.py:54-73)
+    exp_counts, got_counts = exp[3], got[3]
+    np.testing.assert_allclose(
+        got_counts.sort_index().to_numpy(dtype=float),
+        exp_counts.sort_index().to_numpy(dtype=float),
+        atol=1e-8, rtol=0, equal_nan=True)
