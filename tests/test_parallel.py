@@ -79,13 +79,17 @@ def test_sharded_research_step_matches_single(rng, select_method, sim_method):
                                float(sharded.summary.sharpe), atol=1e-8)
 
 
-def test_research_step_mvo_shards(rng):
-    """The QP path (chunked lax.map of ADMM solves) must also compile and run
-    under the mesh shardings."""
+@pytest.mark.parametrize("sim_method", ["mvo", "mvo_turnover"])
+def test_research_step_mvo_shards(rng, sim_method):
+    """The QP paths must also compile and run under the mesh shardings —
+    including the headline ``mvo_turnover`` scheme, whose date scan is the one
+    sequential tail: XLA all-gathers the (loop-invariant) date-sharded inputs
+    once OUTSIDE the scan and runs the scan replicated, so no collective
+    executes per day (asserted by test_mvo_turnover_scan_has_no_loop_collectives)."""
     inputs = make_inputs(rng)
     cfg = dict(names=NAMES, window=WINDOW, select_method="icir_top",
-               sim_kwargs=dict(method="mvo", qp_iters=40, mvo_batch=8,
-                               lookback_period=8))
+               sim_kwargs=dict(method=sim_method, qp_iters=40, mvo_batch=8,
+                               lookback_period=8, max_weight=0.4))
     single = jax.jit(build_research_step(**cfg))(*inputs)
     mesh = make_mesh(("factor", "date"))
     step, shard_inputs = make_sharded_research_step(mesh, **cfg)
@@ -93,6 +97,67 @@ def test_research_step_mvo_shards(rng):
     np.testing.assert_allclose(np.asarray(single.sim.result.log_return),
                                np.asarray(sharded.sim.result.log_return),
                                atol=1e-8, equal_nan=True)
+    np.testing.assert_allclose(np.asarray(single.sim.weights),
+                               np.asarray(sharded.sim.weights),
+                               atol=1e-8, equal_nan=True)
+    np.testing.assert_allclose(float(single.summary.sharpe),
+                               float(sharded.summary.sharpe), atol=1e-8)
+
+
+_COLLECTIVES = ("all-reduce", "all-gather", "collective-permute", "all-to-all",
+                "reduce-scatter")
+
+
+def test_mvo_turnover_scan_has_no_loop_collectives(rng):
+    """The date-sharded mvo_turnover scan must not serialize days through
+    collectives: every HLO computation that contains a collective must be
+    outside all while-loop bodies (XLA hoists the gathers of the
+    loop-invariant sharded operands and replicates the scan)."""
+    import re
+
+    inputs = make_inputs(rng)
+    cfg = dict(names=NAMES, window=WINDOW, select_method="icir_top",
+               sim_kwargs=dict(method="mvo_turnover", qp_iters=10, mvo_batch=8,
+                               lookback_period=8))
+    mesh = make_mesh(("factor", "date"))
+    step, shard_inputs = make_sharded_research_step(mesh, **cfg)
+    hlo = step.lower(*shard_inputs(*inputs)).compile().as_text()
+
+    # map computation name -> its text block
+    blocks = {}
+    current = None
+    for line in hlo.splitlines():
+        m = re.match(r"(?:ENTRY )?%?([\w.\-]+) \(", line)
+        if m and "=" not in line.split("(")[0]:
+            current = m.group(1)
+            blocks[current] = []
+        if current is not None:
+            blocks[current].append(line)
+    # computations reachable from a while body/condition
+    loop_comps = set()
+    frontier = []
+    for name, lines in blocks.items():
+        for ln in lines:
+            m = re.search(r"while\(.*\), condition=%?([\w.\-]+), body=%?([\w.\-]+)", ln)
+            if m:
+                frontier.extend([m.group(1), m.group(2)])
+    while frontier:
+        comp = frontier.pop()
+        if comp in loop_comps or comp not in blocks:
+            continue
+        loop_comps.add(comp)
+        for ln in blocks[comp]:
+            for callee in re.findall(
+                    r"(?:calls|to_apply|body|condition|true_computation|"
+                    r"false_computation)=%?([\w.\-]+)", ln):
+                frontier.append(callee)
+            m = re.search(r"branch_computations=\{([^}]*)\}", ln)
+            if m:  # every cond branch, not just the first
+                frontier.extend(c.strip().lstrip("%")
+                                for c in m.group(1).split(","))
+    offenders = [c for c in loop_comps
+                 if any(op in ln for ln in blocks[c] for op in _COLLECTIVES)]
+    assert not offenders, f"collectives inside loop computations: {offenders}"
 
 
 def make_sweep_inputs(rng, n_combos=8, k=2):
