@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jax import shard_map
 
 from factormodeling_tpu.backtest.pnl import daily_portfolio_returns
@@ -95,6 +95,15 @@ def make_sharded_manager_sweep(mesh: Mesh, *, combo_axis: str = "combo",
     Returns a jitted ``sweep(factors, combo_weights, settings) -> SweepOutput``
     whose per-combo outputs are sharded over ``combo_axis``. ``C`` must be
     divisible by the mesh size (pad with zero-weight combos otherwise).
+
+    The one-time book pass runs FACTOR-sharded over the same mesh axis: a
+    replicated-output computation would otherwise be executed redundantly by
+    every device under SPMD partitioning (measured 7.9x the single-device
+    sweep time at 8 devices on zero-communication combo work — the round-3
+    weak-scaling collapse). Factor shards need no communication at all (each
+    device builds complete ``[D, N]`` books for its factors); the single
+    all-gather to the replicated ``shard_map`` operand is inserted by jit at
+    the boundary.
     """
     spec_combo = PartitionSpec(combo_axis)
     rep = PartitionSpec()
@@ -111,9 +120,13 @@ def make_sharded_manager_sweep(mesh: Mesh, *, combo_axis: str = "combo",
             total_log_return=spec_combo, sharpe=spec_combo,
             mean_turnover=spec_combo))
 
+    factor_sharded = NamedSharding(mesh, PartitionSpec(combo_axis, None, None))
+
     @jax.jit
     def sweep(factors, combo_weights, settings):
+        factors = jax.lax.with_sharding_constraint(factors, factor_sharded)
         books, _, _ = compute_manager_weights(factors, settings)
+        books = jax.lax.with_sharding_constraint(books, factor_sharded)
         return sharded(books, combo_weights, settings)
 
     return sweep

@@ -155,6 +155,7 @@ def test_mvo_turnover_scan_has_no_loop_collectives(rng):
             if m:  # every cond branch, not just the first
                 frontier.extend(c.strip().lstrip("%")
                                 for c in m.group(1).split(","))
+    assert loop_comps, "no while loops found in HLO — parser broken"
     offenders = [c for c in loop_comps
                  if any(op in ln for ln in blocks[c] for op in _COLLECTIVES)]
     assert not offenders, f"collectives inside loop computations: {offenders}"
