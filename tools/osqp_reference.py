@@ -1,0 +1,490 @@
+"""A faithful numpy implementation of the OSQP algorithm + a minimal
+cvxpy-compatible expression layer, used to generate QP-parity goldens.
+
+Why this exists: the acceptance criterion for the MVO schemes is "backtest
+metrics agree with the reference's OSQP solves" (SURVEY.md section 7, hard
+parts), but cvxpy/OSQP are not installed in this environment. This module
+lets ``tools/qp_goldens.py`` run the reference's OWN solve paths
+(``/root/reference/portfolio_simulation.py:376-585``) verbatim — covariance
+windowing, shrinkage, fallbacks, pruning, leg renormalization and all — with
+only the numeric QP core swapped for this implementation of the same
+published algorithm (Stellato et al., "OSQP: an operator splitting solver for
+quadratic programs", with the reference's settings: eps_abs=eps_rel=1e-4,
+adaptive rho, polish, and the max_iter=2000 / 100 budgets).
+
+Differences from the C OSQP, and why they do not matter for goldens:
+- no Ruiz equilibration and a deterministic adaptive-rho interval (25): the C
+  solver adapts rho on wall-clock time, so its iteration path is
+  run-to-run NONdeterministic — bit-exact replication is impossible by
+  construction, which is exactly why the acceptance criterion is
+  portfolio-METRIC tolerance, not weight equality;
+- polishing (active-set KKT refinement, paper section 5.2) is implemented,
+  and on these small well-conditioned daily problems it succeeds, so the
+  recorded solutions are the exact QP optima — solver-independent goldens.
+
+Solves:   minimize 1/2 x'Px + q'x   s.t.  l <= Ax <= u
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["osqp_solve", "OSQPResult", "make_cvxpy_stub"]
+
+_SIGMA = 1e-6          # x-regularization (OSQP default)
+_ALPHA = 1.6           # over-relaxation (OSQP default)
+_RHO0 = 0.1            # initial penalty (OSQP default)
+_RHO_EQ_SCALE = 1e3    # equality rows get rho * 1e3 (OSQP default)
+_CHECK_EVERY = 25      # termination + adaptive-rho interval (deterministic)
+_RHO_BOUNDS = (1e-6, 1e6)
+_POLISH_DELTA = 1e-6   # polish regularization (OSQP default)
+
+
+class OSQPResult:
+    def __init__(self, x, y, status, iters, r_prim, r_dual, polished):
+        self.x = x
+        self.y = y
+        self.status = status          # "solved" | "solved_inaccurate" | "max_iter"
+        self.iters = iters
+        self.r_prim = r_prim
+        self.r_dual = r_dual
+        self.polished = polished
+
+
+def _residuals(P, q, A, x, z, y):
+    r_prim = np.max(np.abs(A @ x - z)) if A.size else 0.0
+    r_dual = np.max(np.abs(P @ x + q + A.T @ y)) if A.size else np.max(
+        np.abs(P @ x + q))
+    return r_prim, r_dual
+
+
+def _eps(P, q, A, x, z, y, eps_abs, eps_rel):
+    ax = A @ x
+    e_prim = eps_abs + eps_rel * max(np.max(np.abs(ax), initial=0.0),
+                                     np.max(np.abs(z), initial=0.0))
+    e_dual = eps_abs + eps_rel * max(np.max(np.abs(P @ x), initial=0.0),
+                                     np.max(np.abs(A.T @ y), initial=0.0),
+                                     np.max(np.abs(q), initial=0.0))
+    return e_prim, e_dual
+
+
+def _polish(P, q, A, l, u, x, y, z):
+    """Active-set KKT refinement (paper section 5.2): lower-active rows are
+    those with y < 0, upper-active with y > 0; solve the equality-constrained
+    QP on that set with tiny regularization + one step of iterative
+    refinement, and accept only if it reproduces a feasible, complementary
+    solution."""
+    low = y < 0
+    upp = y > 0
+    act = low | upp
+    m_act = int(act.sum())
+    n = x.shape[0]
+    A_act = A[act]
+    b_act = np.where(low, l, u)[act]
+    k = np.zeros((n + m_act, n + m_act))
+    k[:n, :n] = P + _POLISH_DELTA * np.eye(n)
+    k[:n, n:] = A_act.T
+    k[n:, :n] = A_act
+    k[n:, n:] = -_POLISH_DELTA * np.eye(m_act)
+    rhs = np.concatenate([-q, b_act])
+    try:
+        sol = np.linalg.solve(k, rhs)
+        # one iterative-refinement step against the unregularized KKT
+        k0 = k.copy()
+        k0[:n, :n] -= _POLISH_DELTA * np.eye(n)
+        k0[n:, n:] += _POLISH_DELTA * np.eye(m_act)
+        sol += np.linalg.solve(k, rhs - k0 @ sol)
+    except np.linalg.LinAlgError:
+        return None
+    x_p = sol[:n]
+    y_act = sol[n:]
+    y_p = np.zeros_like(y)
+    y_p[act] = y_act
+    ax = A @ x_p
+    feas = np.all(ax >= l - 1e-9) and np.all(ax <= u + 1e-9)
+    sign_ok = np.all(y_p[low] <= 1e-9) and np.all(y_p[upp] >= -1e-9)
+    if not (feas and sign_ok and np.all(np.isfinite(x_p))):
+        return None
+    return x_p, y_p, np.clip(ax, l, u)
+
+
+def osqp_solve(P, q, A, l, u, *, max_iter=4000, eps_abs=1e-4, eps_rel=1e-4,
+               adaptive_rho=True, polish=True) -> OSQPResult:
+    P = np.asarray(P, float)
+    q = np.asarray(q, float)
+    A = np.asarray(A, float)
+    l = np.asarray(l, float)
+    u = np.asarray(u, float)
+    if not (np.all(np.isfinite(P)) and np.all(np.isfinite(q))
+            and np.all(np.isfinite(A))):
+        # real OSQP rejects non-finite data at setup; the reference catches
+        # the raise and falls back to the equal-weight x0 (e.g. the NaN
+        # single-row covariance on day 1)
+        raise ValueError("Problem data contains NaN/inf")
+    n = q.shape[0]
+    m = l.shape[0]
+
+    eq = (u - l) < 1e-12
+    rho = _RHO0
+
+    def rho_vec(r):
+        rv = np.full(m, r)
+        rv[eq] = r * _RHO_EQ_SCALE
+        return rv
+
+    def factor(r):
+        rv = rho_vec(r)
+        kkt = P + _SIGMA * np.eye(n) + (A.T * rv) @ A
+        return np.linalg.cholesky(kkt), rv
+
+    chol, rv = factor(rho)
+    x = np.zeros(n)
+    z = np.clip(np.zeros(m), l, u)
+    y = np.zeros(m)
+    status, iters = "max_iter", max_iter
+
+    for it in range(1, max_iter + 1):
+        rhs = _SIGMA * x - q + A.T @ (rv * z - y)
+        x_t = np.linalg.solve(chol.T, np.linalg.solve(chol, rhs))
+        z_t = A @ x_t
+        x_new = _ALPHA * x_t + (1 - _ALPHA) * x
+        z_relax = _ALPHA * z_t + (1 - _ALPHA) * z
+        z_new = np.clip(z_relax + y / rv, l, u)
+        y = y + rv * (z_relax - z_new)
+        x, z = x_new, z_new
+
+        if it % _CHECK_EVERY == 0 or it == max_iter:
+            r_prim, r_dual = _residuals(P, q, A, x, z, y)
+            e_prim, e_dual = _eps(P, q, A, x, z, y, eps_abs, eps_rel)
+            if r_prim <= e_prim and r_dual <= e_dual:
+                status, iters = "solved", it
+                break
+            if adaptive_rho and it != max_iter:
+                ratio = np.sqrt((r_prim / max(e_prim, 1e-30))
+                                / max(r_dual / max(e_dual, 1e-30), 1e-30))
+                new_rho = float(np.clip(rho * ratio, *_RHO_BOUNDS))
+                if new_rho > 5 * rho or new_rho < rho / 5:
+                    rho = new_rho
+                    chol, rv = factor(rho)
+
+    r_prim, r_dual = _residuals(P, q, A, x, z, y)
+    if status == "max_iter":
+        # OSQP grants "solved inaccurate" at max_iter when the iterate meets
+        # the reduced-accuracy criteria
+        e_prim, e_dual = _eps(P, q, A, x, z, y, eps_abs * 10, eps_rel * 10)
+        if r_prim <= e_prim and r_dual <= e_dual:
+            status = "solved_inaccurate"
+
+    polished = False
+    if polish and status in ("solved", "solved_inaccurate"):
+        ref = _polish(P, q, A, l, u, x, y, z)
+        if ref is not None:
+            x_p, y_p, z_p = ref
+            rp, rd = _residuals(P, q, A, x_p, z_p, y_p)
+            if max(rp, rd) <= max(r_prim, r_dual) + 1e-12:
+                x, y, z, polished = x_p, y_p, z_p, True
+                r_prim, r_dual = rp, rd
+
+    return OSQPResult(x, y, status, iters, r_prim, r_dual, polished)
+
+
+# --------------------------------------------------------------------------
+# Minimal cvxpy-compatible layer: exactly the surface the reference's solve
+# paths touch (portfolio_simulation.py:376-585).
+# --------------------------------------------------------------------------
+
+class _Affine:
+    """Rows of an affine map over the single decision vector w: M w + b."""
+
+    def __init__(self, M, b):
+        self.M = np.atleast_2d(np.asarray(M, float))
+        self.b = np.atleast_1d(np.asarray(b, float))
+
+    def __sub__(self, other):
+        if isinstance(other, _Affine):
+            return _Affine(self.M - other.M, self.b - other.b)
+        return _Affine(self.M, self.b - np.asarray(other, float))
+
+    def __add__(self, other):
+        if isinstance(other, _Affine):
+            return _Affine(self.M + other.M, self.b + other.b)
+        return _Affine(self.M, self.b + np.asarray(other, float))
+
+    def __rmul__(self, c):
+        return _Affine(float(c) * self.M, float(c) * self.b)
+
+    def __neg__(self):
+        return _Affine(-self.M, -self.b)
+
+    # comparisons build constraints (scalar rows in the reference's usage)
+    def __ge__(self, c):
+        return _Constraint(self, lo=np.asarray(c, float), hi=None)
+
+    def __le__(self, c):
+        return _Constraint(self, lo=None, hi=np.asarray(c, float))
+
+    def __eq__(self, c):  # noqa: A003 - cvxpy semantics, not identity
+        c = np.asarray(c, float)
+        return _Constraint(self, lo=c, hi=c)
+
+    __hash__ = None
+
+
+class _Variable(_Affine):
+    def __init__(self, n):
+        super().__init__(np.eye(n), np.zeros(n))
+        self.n = n
+        self.value = None
+
+    def __getitem__(self, key):
+        m = self.M[key]
+        b = self.b[key]
+        return _Affine(np.atleast_2d(m), np.atleast_1d(b))
+
+
+class _Constraint:
+    def __init__(self, affine, lo, hi):
+        self.affine = affine
+        self.lo = lo
+        self.hi = hi
+
+
+class _Abs:
+    """cp.abs(affine) — only ever consumed by cp.sum in the reference."""
+
+    def __init__(self, affine):
+        self.affine = affine
+
+
+class _L1:
+    """coef * sum(|affine rows|)."""
+
+    def __init__(self, affine, coef=1.0):
+        self.affine = affine
+        self.coef = coef
+
+    def __rmul__(self, c):
+        return _L1(self.affine, self.coef * float(c))
+
+    def __add__(self, other):
+        return _Sum([self, other])
+
+    def __radd__(self, other):
+        return _Sum([other, self])
+
+
+class _Quad:
+    """w' Q w (cp.quad_form with the variable itself, as the reference uses)."""
+
+    def __init__(self, Q):
+        self.Q = np.asarray(Q, float)
+
+    def __add__(self, other):
+        return _Sum([self, other])
+
+    def __sub__(self, other):
+        return _Sum([self, _negate(other)])
+
+
+class _ScalarAffine:
+    """A 1-row affine: an objective term, or a scalar constraint LHS
+    (``cp.sum(w[mask]) == 1.0``)."""
+
+    def __init__(self, row, const=0.0):
+        self.row = np.asarray(row, float).ravel()
+        self.const = float(const)
+
+    def __rmul__(self, c):
+        return _ScalarAffine(float(c) * self.row, float(c) * self.const)
+
+    def _as_affine(self):
+        return _Affine(self.row[None, :], np.array([self.const]))
+
+    def __ge__(self, c):
+        return self._as_affine() >= c
+
+    def __le__(self, c):
+        return self._as_affine() <= c
+
+    def __eq__(self, c):  # noqa: A003 - cvxpy semantics, not identity
+        return self._as_affine() == c
+
+    __hash__ = None
+
+
+def _negate(term):
+    if isinstance(term, _L1):
+        return _L1(term.affine, -term.coef)
+    if isinstance(term, _ScalarAffine):
+        return _ScalarAffine(-term.row, -term.const)
+    raise TypeError(term)
+
+
+class _Sum:
+    def __init__(self, terms):
+        self.terms = list(terms)
+
+    def __add__(self, other):
+        return _Sum(self.terms + [other])
+
+    def __sub__(self, other):
+        return _Sum(self.terms + [_negate(other)])
+
+
+class _Minimize:
+    def __init__(self, expr):
+        self.expr = expr
+
+
+class _Problem:
+    def __init__(self, objective, constraints):
+        self.objective = objective
+        self.constraints = constraints
+        self.status = None
+
+    # Optional override applied on top of the caller's solver settings. The
+    # golden generator sets this to tight tolerances so the recorded solves
+    # are the exact QP optima (solver-independent goldens): real OSQP at the
+    # reference's relaxed eps=1e-4 wanders nondeterministically around the
+    # optimum (time-based rho adaptation), so the optimum itself is the only
+    # reproducible reference point — acceptance tolerances absorb both
+    # solvers' slack.
+    FORCE_SETTINGS: dict | None = None
+
+    def solve(self, solver=None, verbose=False, eps_abs=1e-4, eps_rel=1e-4,
+              max_iter=4000, adaptive_rho=True, polish=True, warm_start=True,
+              **kwargs):
+        del solver, verbose, warm_start, kwargs
+        if _Problem.FORCE_SETTINGS:
+            eps_abs = _Problem.FORCE_SETTINGS.get("eps_abs", eps_abs)
+            eps_rel = _Problem.FORCE_SETTINGS.get("eps_rel", eps_rel)
+            max_iter = _Problem.FORCE_SETTINGS.get("max_iter", max_iter)
+        expr = self.objective.expr
+        terms = expr.terms if isinstance(expr, _Sum) else [expr]
+
+        # every term and constraint shares one Variable in the reference's
+        # usage; n is recovered from the quad/affine shapes
+        Q = None
+        lin = None
+        l1_rows = None
+        l1_coef = 0.0
+        n = None
+        for t in terms:
+            if isinstance(t, _Quad):
+                Q = t.Q if Q is None else Q + t.Q
+                n = t.Q.shape[0]
+            elif isinstance(t, _L1):
+                if abs(t.coef) > 0:
+                    if l1_rows is not None:
+                        raise NotImplementedError(
+                            "multiple L1 objective terms")
+                    l1_rows = t.affine
+                    l1_coef = t.coef
+            elif isinstance(t, _ScalarAffine):
+                lin = t.row if lin is None else lin + t.row
+        if n is None:
+            n = lin.shape[0]
+        if Q is None:
+            Q = np.zeros((n, n))
+        if lin is None:
+            lin = np.zeros(n)
+
+        k = 0 if l1_rows is None else l1_rows.M.shape[0]
+        # x = [w; t], t_i >= |row_i(w) + b_i|
+        P = np.zeros((n + k, n + k))
+        P[:n, :n] = 2.0 * Q              # quad_form is w'Qw = 1/2 w'(2Q)w
+        q = np.concatenate([lin, np.full(k, l1_coef)])
+
+        rows, lo, hi = [], [], []
+        big = 1e30
+        for c in self.constraints:
+            M, b = c.affine.M, c.affine.b
+            for i in range(M.shape[0]):
+                rows.append(np.concatenate([M[i], np.zeros(k)]))
+                lo.append(-big if c.lo is None else float(np.atleast_1d(c.lo)[min(i, np.atleast_1d(c.lo).size - 1)]) - b[i])
+                hi.append(big if c.hi is None else float(np.atleast_1d(c.hi)[min(i, np.atleast_1d(c.hi).size - 1)]) - b[i])
+        for i in range(k):
+            # row(w) - t_i <= -b_i  and  -row(w) - t_i <= b_i
+            r1 = np.concatenate([l1_rows.M[i], np.zeros(k)])
+            r1[n + i] = -1.0
+            rows.append(r1)
+            lo.append(-big)
+            hi.append(-l1_rows.b[i])
+            r2 = np.concatenate([-l1_rows.M[i], np.zeros(k)])
+            r2[n + i] = -1.0
+            rows.append(r2)
+            lo.append(-big)
+            hi.append(l1_rows.b[i])
+
+        res = osqp_solve(P, q, np.array(rows), np.array(lo), np.array(hi),
+                         max_iter=max_iter, eps_abs=eps_abs, eps_rel=eps_rel,
+                         adaptive_rho=adaptive_rho, polish=polish)
+        self._result = res
+        if res.status == "solved":
+            self.status = "optimal"
+        elif res.status == "solved_inaccurate":
+            self.status = "optimal_inaccurate"
+        else:
+            self.status = "solver_error"
+        if self.status in ("optimal", "optimal_inaccurate"):
+            self._var_value = res.x[:n]
+        else:
+            self._var_value = None
+        # push the value into the Variable the caller holds
+        if _Problem._ACTIVE_VAR is not None:
+            _Problem._ACTIVE_VAR.value = self._var_value
+        return None
+
+    _ACTIVE_VAR = None
+
+
+def make_cvxpy_stub():
+    """A module-like namespace exposing the cvxpy names the reference touches;
+    install with ``sys.modules['cvxpy'] = make_cvxpy_stub()``."""
+    import types
+
+    mod = types.ModuleType("cvxpy")
+
+    def Variable(n):
+        v = _Variable(n)
+        _Problem._ACTIVE_VAR = v
+        return v
+
+    def quad_form(w, Q):
+        if not isinstance(w, _Variable):
+            raise NotImplementedError("quad_form only on the raw variable")
+        return _Quad(Q)
+
+    def _sum(expr):
+        if isinstance(expr, _Abs):
+            return _L1(expr.affine)
+        if isinstance(expr, _Affine):
+            return _ScalarAffine(expr.M.sum(axis=0), expr.b.sum())
+        raise NotImplementedError(type(expr))
+
+    def _abs(expr):
+        return _Abs(expr)
+
+    def multiply(c, expr):
+        if not isinstance(expr, _Affine):
+            raise NotImplementedError(type(expr))
+        c = np.asarray(c, float)
+        return _Affine(expr.M * c[:, None], expr.b * c)
+
+    mod.Variable = Variable
+    mod.quad_form = quad_form
+    mod.sum = _sum
+    mod.abs = _abs
+    mod.multiply = multiply
+    mod.Minimize = _Minimize
+    mod.Problem = _Problem
+    mod.OSQP = "OSQP"
+    mod.OPTIMAL = "optimal"
+    mod.OPTIMAL_INACCURATE = "optimal_inaccurate"
+    mod.FORCE_SETTINGS = None
+
+    def set_force_settings(settings):
+        _Problem.FORCE_SETTINGS = settings
+
+    mod.set_force_settings = set_force_settings
+    return mod

@@ -1,0 +1,195 @@
+"""Generate QP-parity goldens: the reference's mvo / mvo_turnover backtests
+on a pinned 30x20 panel, solved to the exact QP optima.
+
+The reference's solve paths (``/root/reference/portfolio_simulation.py:
+376-585``) run VERBATIM — covariance windowing, shrinkage, the fallback
+ladder, turnover pruning + leg renormalization, the 1-day weight shift and
+the tiered-cost P&L all execute from the reference checkout — with
+``tools/osqp_reference.py`` standing in for cvxpy/OSQP (not installed here).
+Solver tolerances are forced tight (eps 1e-9 + active-set polish) so every
+recorded daily solve is the exact optimum of the reference's QP: real OSQP
+at the reference's relaxed eps=1e-4 is run-to-run nondeterministic
+(time-based rho adaptation), so the optimum is the only reproducible
+reference point; ``tests/test_qp_goldens.py`` gives the engine an acceptance
+band wide enough to absorb both solvers' slack.
+
+Usage::
+
+    python tools/qp_goldens.py        # rewrites tests/goldens/qp_osqp.json
+
+The panel is embedded in the artifact (not just the seed) so the test never
+depends on cross-version rng reproducibility.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+REPO = Path(__file__).resolve().parent.parent
+REFERENCE_DIR = "/root/reference"
+OUT = REPO / "tests" / "goldens" / "qp_osqp.json"
+
+D, N = 30, 20
+SEED = 733
+SETTINGS = dict(method=None, transaction_cost=True, max_weight=0.35, pct=0.3,
+                lookback_period=12, shrinkage_intensity=0.1,
+                turnover_penalty=0.1, return_weight=0.0)
+
+
+def make_panel():
+    rng = np.random.default_rng(SEED)
+    dates = pd.date_range("2022-01-03", periods=D, freq="B")
+    symbols = [f"S{i:02d}" for i in range(N)]
+    returns = rng.normal(scale=0.02, size=(D, N))
+    returns[rng.uniform(size=(D, N)) < 0.02] = np.nan
+    cap = rng.integers(1, 4, size=(D, N)).astype(float)
+    signal = rng.normal(size=(D, N))
+    signal[rng.uniform(size=(D, N)) < 0.1] = 0.0  # zero-signal pinning
+    signal[4] = np.abs(signal[4])                 # one single-leg (flat) day
+    return dates, symbols, returns, cap, signal
+
+
+def to_long(dense, dates, symbols, name):
+    idx = pd.MultiIndex.from_product([dates, symbols],
+                                     names=["date", "symbol"])
+    # .copy(): a read-only ravel view makes the reference's in-place pivot
+    # ops raise, silently degrading every day to the equal-scheme fallback
+    return pd.Series(np.asarray(dense, float).ravel().copy(), index=idx,
+                     name=name)
+
+
+def _patch_fill_diagonal():
+    """pandas-3 compat for the reference's in-place covariance jitter
+    (``portfolio_simulation.py:353``): ``DataFrame.values`` is a read-only
+    view under copy-on-write, which would silently send EVERY day down the
+    equal-scheme fallback. The underlying block array is writable, so
+    force-enabling the view keeps the reference's mutation semantics."""
+    orig = np.fill_diagonal
+
+    def patched(a, val, wrap=False):
+        if isinstance(a, np.ndarray) and not a.flags.writeable:
+            try:
+                a.flags.writeable = True
+            except ValueError:
+                pass
+        return orig(a, val, wrap=wrap)
+
+    np.fill_diagonal = patched
+    return orig
+
+
+def import_reference():
+    """Returns (portfolio_simulation module, restore_fn); call ``restore_fn``
+    after the runs to undo the process-wide fill_diagonal patch."""
+    sys.path.insert(0, str(REPO))
+    from tools.osqp_reference import make_cvxpy_stub
+
+    orig_fill_diagonal = _patch_fill_diagonal()
+
+    def restore():
+        np.fill_diagonal = orig_fill_diagonal
+
+    saved = sys.modules.copy()
+    sm = types.ModuleType("statsmodels")
+    sm_api = types.ModuleType("statsmodels.api")
+    sm_api.OLS = object
+    sm_api.add_constant = object
+    sm.api = sm_api
+    cp = make_cvxpy_stub()
+    cp.set_force_settings(dict(eps_abs=1e-9, eps_rel=1e-9, max_iter=40000))
+    for name in ("portfolio_simulation", "portfolio_analyzer"):
+        sys.modules.pop(name, None)
+    sys.modules["statsmodels"] = sm
+    sys.modules["statsmodels.api"] = sm_api
+    sys.modules["cvxpy"] = cp
+    sys.path.insert(0, REFERENCE_DIR)
+    importlib.invalidate_caches()
+    try:
+        ps = importlib.import_module("portfolio_simulation")
+    finally:
+        sys.path.remove(REFERENCE_DIR)
+        for k in list(sys.modules):
+            if k not in saved:
+                del sys.modules[k]
+        sys.modules.update(saved)
+    return ps, restore
+
+
+def main():
+    dates, symbols, returns, cap, signal = make_panel()
+    ps, restore_numpy = import_reference()
+
+    ret_l = to_long(returns, dates, symbols, "log_return")
+    cap_l = to_long(cap, dates, symbols, "cap_flag")
+    inv_l = to_long(np.ones((D, N)), dates, symbols, "investability_flag")
+    sig_l = to_long(signal, dates, symbols, "signal")
+
+    artifact = {
+        "doc": "reference Simulation run verbatim with exact-QP OSQP-algorithm "
+               "solves (tools/qp_goldens.py); weights are post-shift trade "
+               "weights, result columns sorted by date ascending",
+        "seed": SEED,
+        "settings": {k: v for k, v in SETTINGS.items() if k != "method"},
+        "dates": [str(d.date()) for d in dates],
+        "symbols": symbols,
+        "returns": np.asarray(returns).tolist(),
+        "cap_flag": np.asarray(cap).tolist(),
+        "signal": np.asarray(signal).tolist(),
+        "methods": {},
+    }
+
+    for method in ("mvo", "mvo_turnover"):
+        settings = ps.SimulationSettings(
+            returns=ret_l, cap_flag=cap_l, investability_flag=inv_l,
+            factors_df=pd.DataFrame(index=ret_l.index),
+            **{**SETTINGS, "method": method},
+            plot=False, output_returns=True)
+        sim = ps.Simulation(f"golden_{method}", sig_l.copy(), settings)
+        sim.custom_feature = sim.custom_feature * sim.investability_flag
+        weights, counts = sim._daily_trade_list()
+        result, _, _ = sim._daily_portfolio_returns(weights)
+        result = result.sort_values("date")
+
+        w_dense = (weights.unstack("symbol")
+                   .reindex(index=dates, columns=symbols).to_numpy())
+        artifact["methods"][method] = {
+            "weights": w_dense.tolist(),
+            "long_count": counts["long_count"].reindex(dates).tolist(),
+            "short_count": counts["short_count"].reindex(dates).tolist(),
+            "result": {col: result[col].tolist()
+                       for col in ("log_return", "long_return", "short_return",
+                                   "long_turnover", "short_turnover",
+                                   "turnover")},
+        }
+        # sanity: real QP solves happened — an equal-scheme fallback puts
+        # identical weights on every long name; the variance-optimal solution
+        # does not (beyond the warmup days the ladder legitimately covers)
+        distinct = 0
+        for t in range(2, D - 1):  # weight day t+1 trades on signal day t
+            row = w_dense[t + 1]
+            pos_w = row[np.nan_to_num(signal[t]) > 0]
+            pos_w = pos_w[np.isfinite(pos_w) & (pos_w > 0)]
+            if pos_w.size > 1 and np.ptp(pos_w) > 1e-9:
+                distinct += 1
+        assert distinct >= D // 2, (
+            f"{method}: only {distinct} days show non-equal long weights — "
+            "the QP path is not actually running")
+        total = np.nansum(np.asarray(result["log_return"], float))
+        print(f"{method}: total_log_return={total:+.6f} "
+              f"(QP-shaped days: {distinct}/{D})")
+
+    restore_numpy()
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(artifact))
+    print(f"wrote {OUT} ({OUT.stat().st_size // 1024} KiB)")
+
+
+if __name__ == "__main__":
+    main()
