@@ -23,7 +23,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax.scipy.special import betainc
 
-from factormodeling_tpu.ops._rank import rank_sorted
 from factormodeling_tpu.ops._window import masked_shift, rolling_sum, shift
 
 METRIC_COLUMNS = (
@@ -57,6 +56,64 @@ def _masked_pearson(a: jnp.ndarray, b: jnp.ndarray, valid: jnp.ndarray) -> jnp.n
     return cov / jnp.sqrt(va * vb)
 
 
+def _rank_ic(f: jnp.ndarray, r: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Pearson(rank(f), r) along the asset axis, the whole stack at once.
+
+    The cost of ranking on TPU is the sort, so everything is arranged around
+    ONE unstable single-key sort carrying r as a payload (Pearson is
+    invariant to payload permutation within a tie run, so stability — which
+    XLA implements by appending an iota tiebreak key, measured ~30% slower at
+    10x5040x5000 — buys nothing). NaNs are canonicalized so the total order
+    sends them last; valid cells therefore occupy the sorted prefix.
+
+    All moments are computed with centered accumulation (rank magnitudes ~5e3
+    make the uncentered forms cancel catastrophically in f32), using the
+    closed-form rank mean ``(n_valid + 1) / 2`` — exact under average ties,
+    which preserve the rank total.
+
+    A ties-absent ``lax.cond`` fast path (closed-form rank variance, no
+    tie-run scans) was measured SLOWER than this unconditional version at
+    10x5040x5000 on v5e: the cond's operand cloning cost ~90 ms against
+    ~40 ms of scan savings. The profile for this formulation: unstable sort
+    ~180 ms, everything else ~100 ms, vs ~260 + ~120 for the round-3 stable
+    sort + generic masked-Pearson version.
+    """
+    from jax import lax
+
+    key = jnp.where(valid, f, jnp.nan)
+    rr = jnp.broadcast_to(jnp.where(valid, r, 0.0), key.shape)
+    s_key, r_s = lax.sort((key, rr), dimension=key.ndim - 1, num_keys=1,
+                          is_stable=False)
+
+    n = key.shape[-1]
+    from factormodeling_tpu.metrics import _pallas_rank_ic as _pri
+
+    if (_pri.pallas_available() and key.dtype == jnp.float32
+            and r_s.dtype == jnp.float32
+            and n % 8 == 0 and 256 <= n <= _pri.MAX_SORTED_WIDTH):
+        # one fused VMEM pass over the sorted arrays (see the kernel module)
+        ic, _ = _pri.rank_ic_postsort(s_key.reshape(-1, n),
+                                      r_s.reshape(-1, n))
+        return ic.reshape(key.shape[:-1])
+
+    from factormodeling_tpu.ops._rank import sorted_avg_ranks
+
+    vs = ~jnp.isnan(s_key)
+    cnt = valid.sum(axis=_ASSET_AXIS).astype(key.dtype)
+    cs = jnp.where(cnt > 0, cnt, jnp.nan)
+
+    mr = r_s.sum(axis=_ASSET_AXIS) / cs
+    dr = jnp.where(vs, r_s - mr[..., None], 0.0)
+    var_r = (dr * dr).sum(axis=_ASSET_AXIS)
+
+    ranks = sorted_avg_ranks(s_key, vs)
+    mrank = (cs + 1.0) * 0.5
+    drk = jnp.where(vs, ranks - mrank[..., None], 0.0)
+    cov = (drk * dr).sum(axis=_ASSET_AXIS)
+    var_rank = (drk * drk).sum(axis=_ASSET_AXIS)
+    return cov / jnp.sqrt(var_rank * var_r)
+
+
 def daily_factor_stats(factors: jnp.ndarray, returns: jnp.ndarray,
                        *, shift_periods: int = 1,
                        universe: jnp.ndarray | None = None,
@@ -73,9 +130,10 @@ def daily_factor_stats(factors: jnp.ndarray, returns: jnp.ndarray,
       universe: optional ``bool[D, N]`` membership mask (shift hops gaps).
       min_pairs: dates with fewer valid pairs are NaN (reference skips < 3).
       stats: which stats to compute. ``rank_ic`` costs one ``lax.sort`` of
-        the whole stack — the dominant cost of this function at scale
-        (~3x the rest combined at 5040x5000) — so callers whose selector
-        consumes only ``factor_return`` (e.g. momentum) should drop it;
+        the whole stack — still the dominant cost at scale even with the
+        fused Pallas post-sort stage (the sort is ~180 ms of the ~225 ms
+        total at 10x5040x5000 on v5e) — so callers whose selector consumes
+        only ``factor_return`` (e.g. momentum) should drop it;
         requested-but-unreturned stats cannot be dead-code-eliminated once
         they are jit outputs.
 
@@ -110,14 +168,7 @@ def daily_factor_stats(factors: jnp.ndarray, returns: jnp.ndarray,
     if "ic" in stats:
         out["ic"] = jnp.where(enough, _masked_pearson(f, r, valid), nan)
     if "rank_ic" in stats:
-        # rank-IC in sorted space: Pearson is permutation-invariant, so carry
-        # r through the rank sort as a payload operand — no second sort to
-        # un-permute the ranks, no gather (both lower poorly on TPU; the one
-        # sort dominates this whole function's cost)
-        franks_sorted, valid_sorted, (r_sorted,) = rank_sorted(
-            f, axis=_ASSET_AXIS, carry=(r,))
-        rank_ic = _masked_pearson(franks_sorted, r_sorted, valid_sorted)
-        out["rank_ic"] = jnp.where(enough, rank_ic, nan)
+        out["rank_ic"] = jnp.where(enough, _rank_ic(f, r, valid), nan)
     if "factor_return" in stats:
         f0 = jnp.where(valid, f, 0.0)
         r0 = jnp.where(valid, r, 0.0)

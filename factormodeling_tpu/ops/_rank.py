@@ -16,7 +16,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["avg_rank", "masked_quantile", "rank_sorted", "segment_avg_rank"]
+__all__ = ["avg_rank", "masked_quantile", "rank_sorted", "segment_avg_rank",
+           "sorted_avg_ranks"]
 
 _TIE_METHODS = ("average", "min", "max", "first", "dense")
 
@@ -153,6 +154,29 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
     return ranks, counts
 
 
+def sorted_avg_ranks(s_key: jnp.ndarray, valid_sorted: jnp.ndarray,
+                     axis: int = -1) -> jnp.ndarray:
+    """Average-tie 1-based ranks of an ALREADY-SORTED key array (NaNs last,
+    canonicalized so NaN != NaN puts each in its own run); invalid cells get
+    rank NaN. Shared post-sort stage of :func:`rank_sorted` (method
+    'average') and the rank-IC pipeline
+    (``metrics/factor_metrics._rank_ic``'s XLA fallback)."""
+    axis = axis % s_key.ndim
+    n = s_key.shape[axis]
+    prev = jnp.concatenate(
+        [lax.slice_in_dim(s_key, 0, 1, axis=axis),
+         lax.slice_in_dim(s_key, 0, n - 1, axis=axis)], axis=axis)
+    first_col = jnp.concatenate(
+        [jnp.ones_like(lax.slice_in_dim(valid_sorted, 0, 1, axis=axis)),
+         jnp.zeros_like(lax.slice_in_dim(valid_sorted, 0, n - 1, axis=axis))],
+        axis=axis)
+    tie_start = first_col | (s_key != prev)
+    tie_first = _run_starts_to_first(tie_start, axis)
+    tie_last = _run_starts_to_last(tie_start, axis)
+    ranks = 0.5 * (tie_first + tie_last).astype(s_key.dtype) + 1.0
+    return jnp.where(valid_sorted, ranks, jnp.nan)
+
+
 def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=(),
                 method: str = "average"):
     """1-based ranks **in sorted order** (``method`` = any pandas tie rule,
@@ -192,9 +216,8 @@ def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=(),
         axis=axis)
     tie_start = first_col | (s_key != shift_one(s_key))  # NaN != NaN -> own run
     if method == "average":
-        tie_first = _run_starts_to_first(tie_start, axis)
-        tie_last = _run_starts_to_last(tie_start, axis)
-        ranks_sorted = 0.5 * (tie_first + tie_last).astype(values.dtype) + 1.0
+        return (sorted_avg_ranks(s_key, valid_sorted, axis=axis),
+                valid_sorted, tuple(s_carry))
     elif method == "min":
         ranks_sorted = _run_starts_to_first(tie_start, axis).astype(values.dtype) + 1.0
     elif method == "max":

@@ -67,3 +67,30 @@ def test_factor_return_is_no_intercept_beta(rng):
     f, r = factors[2, 10], returns[10]
     exp = np.dot(f, r) / np.dot(f, f)
     np.testing.assert_allclose(float(daily["factor_return"][2, 10]), exp, rtol=1e-10)
+
+
+def test_rank_ic_tie_and_no_tie_branches_match_scipy(rng):
+    """_rank_ic must match scipy on both continuous (tie-free) and
+    discretized (tie-heavy) factors; this config exercises the XLA fallback
+    (the Pallas kernel is pinned by tests/test_pallas_rank_ic.py)."""
+    from scipy.stats import rankdata
+
+    def scipy_rank_ic(factors, returns):
+        out = np.full((factors.shape[0], D), np.nan)
+        for fi in range(factors.shape[0]):
+            for t in range(1, D):
+                f = factors[fi, t - 1]
+                v = ~np.isnan(f) & ~np.isnan(returns[t])
+                if v.sum() < 3:
+                    continue
+                out[fi, t] = np.corrcoef(rankdata(f[v]), returns[t, v])[0, 1]
+        return out
+
+    continuous, returns = make_stack(rng)          # ties ~impossible
+    tied = np.round(continuous * 2.0) / 2.0        # heavy exact ties
+    for factors in (continuous, tied):
+        got = np.asarray(daily_factor_stats(
+            jnp.array(factors), jnp.array(returns))["rank_ic"])
+        exp = scipy_rank_ic(factors, returns)
+        np.testing.assert_allclose(got, exp, rtol=1e-8, atol=1e-10,
+                                   equal_nan=True)
