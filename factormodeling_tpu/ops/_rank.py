@@ -204,6 +204,9 @@ def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=(),
     s_key, *s_carry = lax.sort(operands, dimension=axis, num_keys=1,
                                is_stable=True)
     valid_sorted = ~jnp.isnan(s_key)
+    if method == "average":
+        return (sorted_avg_ranks(s_key, valid_sorted, axis=axis),
+                valid_sorted, tuple(s_carry))
 
     def shift_one(a):
         return jnp.concatenate(
@@ -215,10 +218,7 @@ def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=(),
          jnp.zeros_like(lax.slice_in_dim(valid_sorted, 0, n - 1, axis=axis))],
         axis=axis)
     tie_start = first_col | (s_key != shift_one(s_key))  # NaN != NaN -> own run
-    if method == "average":
-        return (sorted_avg_ranks(s_key, valid_sorted, axis=axis),
-                valid_sorted, tuple(s_carry))
-    elif method == "min":
+    if method == "min":
         ranks_sorted = _run_starts_to_first(tie_start, axis).astype(values.dtype) + 1.0
     elif method == "max":
         ranks_sorted = _run_starts_to_last(tie_start, axis).astype(values.dtype) + 1.0

@@ -40,6 +40,7 @@ from factormodeling_tpu.parallel.streaming import (  # noqa: F401
     clear_streaming_cache,
     host_array_source,
     streamed_factor_stats,
+    streamed_linear_research,
     streamed_weighted_composite,
 )
 from factormodeling_tpu.parallel.sweep import (  # noqa: F401

@@ -49,7 +49,8 @@ from factormodeling_tpu import ops
 from factormodeling_tpu.metrics import daily_factor_stats
 
 __all__ = ["chunk_slices", "clear_streaming_cache", "host_array_source",
-           "streamed_factor_stats", "streamed_weighted_composite"]
+           "streamed_factor_stats", "streamed_linear_research",
+           "streamed_weighted_composite"]
 
 # The per-chunk jits are cached on (source, config), NOT rebuilt per call —
 # a fresh jax.jit wrapper per invocation would recompile every kernel on
@@ -180,6 +181,122 @@ def _stats_kernel(fused_source, shift_periods: int, stats: tuple):
                           build)
 
 
+def _apply_transform(fac, universe, transform):
+    if transform == "zscore":
+        return ops.cs_zscore(fac, universe=universe)
+    if transform == "rank":
+        return ops.cs_rank(fac, universe=universe)
+    if transform == "none":
+        return fac
+    return transform(fac)
+
+
+def streamed_linear_research(source: Callable[[int], jnp.ndarray],
+                             n_chunks: int, returns: jnp.ndarray, *,
+                             chunk_weight_fn: Callable,
+                             transform: Callable | str = "zscore",
+                             shift_periods: int = 1,
+                             universe: jnp.ndarray | None = None,
+                             stats: tuple = ("ic", "rank_ic",
+                                             "factor_return"),
+                             fuse_source: bool = False,
+                             prefetch: int = 0) -> dict:
+    """SINGLE-pass scoring + selection + blend for factor-separable selectors.
+
+    The two-pass flow (:func:`streamed_factor_stats` then
+    :func:`streamed_weighted_composite`) reads the factor stack twice because
+    general selection couples factors (e.g. icir_top's cross-factor top-k).
+    But a selector whose daily weights are *factorwise* up to one global
+    per-date normalizer —
+
+        w[f, d] = u[f, d] / sum_g u[g, d],   u[f, d] = fn(stats of factor f)
+
+    (factor momentum, ``factor_selection_methods.py:28-58``, is exactly this:
+    ``u = clip(window-sum of factor returns, 0, cap)``) — lets every chunk be
+    visited ONCE: the chunk's stats, its unnormalized weights ``u``, and its
+    contribution ``sum_f u[f, d] * transform(chunk)[f, d, n]`` all come out
+    of one kernel while the chunk is resident, and the normalizer divides at
+    the end:
+
+        composite = (sum_chunks partial) / (sum_chunks sum_f u)
+
+    — algebraically identical to the two-pass result, at half the stack
+    traffic (and for fused device sources, half the regeneration).
+
+    Args:
+      chunk_weight_fn: traceable ``fn(stats_dict) -> float[C, D]`` mapping a
+        CHUNK's :func:`daily_factor_stats` dict (arrays ``[C, D]``) to that
+        chunk's unnormalized daily weights. It sees only the chunk's own
+        factors — that is the contract that makes one pass possible.
+        Pass a STABLE callable (module-level function or one reused object):
+        the compiled per-chunk kernels are cached on its identity, so a
+        fresh lambda per call recompiles every kernel on every call (the
+        failure mode the cache exists to prevent — see the cache note at
+        the top of this module).
+      Other args as :func:`streamed_factor_stats` /
+        :func:`streamed_weighted_composite`.
+
+    Returns a dict: the requested per-date ``stats`` arrays ``[F, D]``,
+    ``"unnormalized_weights"`` ``[F, D]``, ``"weight_norm"`` ``[D]`` (the
+    per-date normalizer), and ``"composite"`` ``[D, N]`` (zero on dates with
+    no positive weight, like the two-pass blend of all-zero weight rows).
+    """
+    if n_chunks <= 0:
+        raise ValueError(f"n_chunks must be positive, got {n_chunks}")
+    if isinstance(transform, str) and transform not in ("zscore", "rank",
+                                                        "none"):
+        raise ValueError(f"unknown transform {transform!r}; valid: "
+                         "'zscore', 'rank', 'none', or a callable")
+
+    one = _linear_research_kernel(source if fuse_source else None,
+                                  chunk_weight_fn, transform, shift_periods,
+                                  tuple(stats))
+    stat_parts, u_parts, total, norm = [], [], None, None
+    if fuse_source:
+        chunks = iter(range(n_chunks))
+    else:
+        chunks = _prefetched(source, n_chunks, prefetch)
+    for arg0 in chunks:
+        stats_d, u, part = one(arg0, returns, universe)
+        stat_parts.append(stats_d)
+        u_parts.append(u)
+        total = part if total is None else total + part
+        s = u.sum(axis=0)
+        norm = s if norm is None else norm + s
+
+    out = {k: jnp.concatenate([p[k] for p in stat_parts], axis=0)
+           for k in stat_parts[0]}
+    out["unnormalized_weights"] = jnp.concatenate(u_parts, axis=0)
+    out["weight_norm"] = norm
+    safe = jnp.where(norm > 0, norm, 1.0)
+    out["composite"] = jnp.where((norm > 0)[:, None], total / safe[:, None],
+                                 0.0)
+    return out
+
+
+def _linear_research_kernel(fused_source, chunk_weight_fn, transform,
+                            shift_periods: int, stats: tuple):
+    def build():
+        def kernel(fac, returns, universe):
+            stats_d = daily_factor_stats(fac, returns,
+                                         shift_periods=shift_periods,
+                                         universe=universe, stats=stats)
+            u = chunk_weight_fn(stats_d)                      # [C, D]
+            z = _apply_transform(fac, universe, transform)
+            part = jnp.einsum("fd,fdn->dn", u, jnp.nan_to_num(z))
+            return stats_d, u, part
+
+        if fused_source is None:
+            return jax.jit(kernel)
+        return jax.jit(lambda i, returns, universe:
+                       kernel(fused_source(i), returns, universe))
+
+    return _cached_kernel(
+        fused_source,
+        ("linear_research", chunk_weight_fn, transform, shift_periods, stats),
+        build)
+
+
 def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
                                 chunk_weights: Sequence[jnp.ndarray],
                                 *, transform: Callable | str = "zscore",
@@ -233,18 +350,10 @@ def _composite_kernel(fused_source, transform):
     path, ``fused_source=None``) or the traced chunk index (device path)."""
 
     def build():
-        def apply(fac, universe):
-            if transform == "zscore":
-                return ops.cs_zscore(fac, universe=universe)
-            if transform == "rank":
-                return ops.cs_rank(fac, universe=universe)
-            if transform == "none":
-                return fac
-            return transform(fac)
-
         def kernel(fac, w, universe):
-            return jnp.einsum("fd,fdn->dn", w,
-                              jnp.nan_to_num(apply(fac, universe)))
+            return jnp.einsum(
+                "fd,fdn->dn", w,
+                jnp.nan_to_num(_apply_transform(fac, universe, transform)))
 
         if fused_source is None:
             return jax.jit(kernel)

@@ -184,3 +184,76 @@ def test_prefetched_host_source_matches_serial(rng):
     c0 = streaming.streamed_weighted_composite(source, [w] * 4, prefetch=0)
     c2 = streaming.streamed_weighted_composite(source, [w] * 4, prefetch=2)
     np.testing.assert_array_equal(np.asarray(c0), np.asarray(c2))
+
+
+@pytest.mark.parametrize("chunk", [1, 4, F])
+def test_linear_research_matches_two_pass(panel, chunk):
+    """The single-pass flow must equal stats -> factor-separable selection ->
+    weighted composite done as two passes, for any chunking."""
+    from factormodeling_tpu.ops._window import rolling_sum, shift
+    from factormodeling_tpu.parallel import streamed_linear_research
+
+    stack, returns, universe = panel
+    window = 6
+
+    def unnorm(factor_ret):  # [*, D] momentum-style factorwise weights
+        ok = ~jnp.isnan(factor_ret)
+        sums = rolling_sum(jnp.where(ok, factor_ret, 0.0), window, axis=-1)
+        mom = jnp.maximum(shift(sums, 1, axis=-1, fill_value=0.0), 0.0)
+        i = jnp.arange(D)
+        processed = (i >= window) & (i <= D - 2)
+        return jnp.where(processed[None, :], mom, 0.0)
+
+    source, slices = host_array_source(stack, chunk)
+    res = streamed_linear_research(
+        source, len(slices), jnp.asarray(returns),
+        chunk_weight_fn=lambda s: unnorm(s["factor_return"]),
+        transform="zscore", universe=jnp.asarray(universe))
+
+    # two-pass oracle on the dense stack
+    daily = daily_factor_stats(jnp.asarray(stack), jnp.asarray(returns),
+                               universe=jnp.asarray(universe))
+    u = unnorm(daily["factor_return"])                   # [F, D]
+    norm = u.sum(axis=0)
+    w = jnp.where(norm > 0, u / jnp.where(norm > 0, norm, 1.0), 0.0)
+    z = ops.cs_zscore(jnp.asarray(stack), universe=jnp.asarray(universe))
+    comp = jnp.einsum("fd,fdn->dn", w, jnp.nan_to_num(z))
+
+    np.testing.assert_allclose(np.asarray(res["unnormalized_weights"]),
+                               np.asarray(u), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res["weight_norm"]),
+                               np.asarray(norm), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res["composite"]),
+                               np.asarray(comp), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(res["factor_return"]), np.asarray(daily["factor_return"]),
+        atol=1e-6, equal_nan=True)
+
+
+def test_linear_research_fused_device_source(rng):
+    """fuse_source=True (traced chunk index) must match the host-source path."""
+    import jax
+
+    from factormodeling_tpu.parallel import streamed_linear_research
+
+    f, chunk = 8, 4
+    stack = rng.normal(size=(f, D, N)).astype(np.float32)
+    returns = jnp.asarray(rng.normal(scale=0.02, size=(D, N)).astype(np.float32))
+    dev_stack = jnp.asarray(stack)
+
+    def dev_source(i):  # traceable: dynamic_slice on a device stack
+        return jax.lax.dynamic_slice(
+            dev_stack, (i * chunk, 0, 0), (chunk, D, N))
+
+    def host_source(i):
+        return jnp.asarray(stack[i * chunk:(i + 1) * chunk])
+
+    fn = lambda s: jnp.nan_to_num(jnp.abs(s["factor_return"]))
+    a = streamed_linear_research(dev_source, f // chunk, returns,
+                                 chunk_weight_fn=fn, fuse_source=True)
+    b = streamed_linear_research(host_source, f // chunk, returns,
+                                 chunk_weight_fn=fn)
+    np.testing.assert_allclose(np.asarray(a["composite"]),
+                               np.asarray(b["composite"]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a["weight_norm"]),
+                               np.asarray(b["weight_norm"]), atol=1e-6)
