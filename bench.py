@@ -42,6 +42,13 @@ _PEAK_BF16_TFLOPS = {  # per-chip MXU peaks, for an indicative MFU figure
     "TPU v6e": 918.0,
 }
 
+# peak HBM bandwidth per chip (GB/s, public specs) — the other roofline axis
+_PEAK_HBM_GBPS = {
+    "TPU v4": 1228.0, "TPU v5 lite": 819.0, "TPU v5": 2765.0,
+    "TPU v5e": 819.0, "TPU v5p": 2765.0, "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
 
 def _fence(*arrays) -> float:
     """Materialize a scalar that depends on each output — a reliable
@@ -90,20 +97,38 @@ def _time_chained(chained_step, args, *, reps, dtype,
 
 
 def _result(name, seconds, *, baseline_s=None, baseline_method=None,
-            flops=None, unit="s", extras=None):
+            flops=None, bytes_touched=None, bytes_model=None,
+            roofline_note=None, unit="s", extras=None):
+    """Assemble one published row. ``bytes_touched`` is the config's
+    explicit HBM-traffic model (documented by ``bytes_model``) and yields
+    ``hbm_gbps``/``hbm_frac`` against the chip's peak — the bandwidth axis of
+    the roofline next to tflops/mfu. ``roofline_note`` is the tracked
+    explanation required when a config sits well under BOTH ceilings
+    (latency-bound, sort-network-bound, sequential-scan-bound, ...)."""
     import jax
 
     out = {"metric": name, "value": round(seconds, 4), "unit": unit,
            "vs_baseline": round(baseline_s / seconds, 1) if baseline_s else 0.0}
     if baseline_method:
         out["baseline_method"] = baseline_method
+    kind = jax.devices()[0].device_kind
     if flops:
         tflops = flops / seconds / 1e12
         out["tflops"] = round(tflops, 2)
-        kind = jax.devices()[0].device_kind
         peak = _PEAK_BF16_TFLOPS.get(kind)
         if peak:
             out["mfu_vs_bf16_peak"] = round(tflops / peak, 4)
+    if bytes_touched:
+        gbps = bytes_touched / seconds / 1e9
+        out["hbm_gbps"] = float(f"{gbps:.3g}")  # 3 sig figs: sub-GB/s
+        # serial-bound configs must not round to a misleading fixed decimal
+        peak_bw = _PEAK_HBM_GBPS.get(kind)
+        if peak_bw:
+            out["hbm_frac"] = round(gbps / peak_bw, 4)
+        if bytes_model:
+            out["hbm_bytes_model"] = bytes_model
+    if roofline_note:
+        out["roofline_note"] = roofline_note
     if extras:
         out.update(extras)
     return out
@@ -181,6 +206,11 @@ def bench_rank_ic(smoke=False, profile=False):
                                atol=1e-4)  # f32 vs f64
     return _result(f"rank_ic_{n}assets_{d}d", seconds, baseline_s=baseline_s,
                    baseline_method="numpy/scipy per-date loop, full scale",
+                   bytes_touched=4.0 * (2 * d * n + d),
+                   bytes_model="inputs once + [D] output (compulsory)",
+                   roofline_note="~1 MB workload: dispatch-latency-bound at "
+                                 "this size by design; rank_ic_batched is "
+                                 "the at-scale figure",
                    extras={"end_to_end_single_call_s": round(lone_s, 4),
                            "note": f"value = per-call device time amortized "
                                    f"over {reps} chained dispatches; "
@@ -248,10 +278,23 @@ def bench_rank_ic_batched(smoke=False, profile=False):
     baseline_s = (time.perf_counter() - t0) * (f * d / db)
 
     cells = f * d * n
+    # traffic model: shifted/masked sort operands written + read back by the
+    # sort, sorted pair written + read once by the fused post-sort kernel
+    bytes_touched = 4.0 * (6 * f * d * n + d * n + 2 * f * d)
     return _result(f"rank_ic_batched_{f}f_{n}assets_{d}d", seconds,
                    baseline_s=baseline_s,
                    baseline_method=f"numpy/scipy per-date loop on {db}/{f * d} "
                                    f"factor-dates, extrapolated",
+                   bytes_touched=bytes_touched,
+                   bytes_model="6 stack passes: sort operands w+r, sorted "
+                               "pair w, fused Pallas post-sort r",
+                   roofline_note="sort-comparator-network bound: the "
+                                 "unstable 2-operand lax.sort is ~80% of "
+                                 "device time and sits within ~2x of the "
+                                 "VPU ceiling for a bitonic network (see "
+                                 "docs/architecture.md round-4 notes); "
+                                 "neither MXU nor HBM is the binding "
+                                 "resource",
                    extras={"gcells_per_s": round(cells / seconds / 1e9, 2),
                            "end_to_end_single_call_s": round(lone_s, 4),
                            "note": f"value = per-call device time amortized "
@@ -329,10 +372,17 @@ def bench_composite_ops(smoke=False, profile=False):
     baseline_s = (time.perf_counter() - t0) * (f / fb)
 
     cells = f * d * n
+    # zscore: reduce + apply (~3 stack passes); group stage: two sum dots
+    # read the stack, the scatter-back dot writes/reads the [D, 2F, N]
+    # cells buffer, final subtract writes the result (~8 stack passes)
+    bytes_touched = 4.0 * (11 * f * d * n + d * n)
     return _result(f"composite_ops_{f}f_{n}assets_{d}d", seconds,
                    baseline_s=baseline_s,
                    baseline_method=f"pandas groupby chain on {fb}/{f} factors, "
                                    f"extrapolated x{f / fb:.2f}",
+                   bytes_touched=bytes_touched,
+                   bytes_model="~11 stack passes (zscore 3, one-hot group "
+                               "dots + cells buffer 8)",
                    extras={"gcells_per_s": round(cells / seconds / 1e9, 2),
                            "end_to_end_single_call_s": round(lone_s, 4),
                            "note": f"value = per-call time over {reps} "
@@ -397,11 +447,20 @@ def bench_cs_ols(smoke=False, profile=False):
     baseline_s = (time.perf_counter() - t0) * (d / db)
 
     flops = 2.0 * d * n * f * f  # the normal-equation einsum dominates
+    # x read twice (X X' and X y batch dots at HIGHEST precision), y once
+    bytes_touched = 4.0 * (2 * f * d * n + d * n + d * f)
     return _result(f"cs_ols_{n}assets_{f}f_{d}d", seconds,
                    baseline_s=baseline_s,
                    baseline_method=f"numpy lstsq per-date loop on {db}/{d} "
                                    f"dates, extrapolated",
                    flops=flops,
+                   bytes_touched=bytes_touched,
+                   bytes_model="x stack twice (X X', X y), y once, betas out",
+                   roofline_note="f=20 contractions fill 20/128 MXU tiles "
+                                 "and run f32-HIGHEST (3-pass bf16 "
+                                 "emulation) for oracle parity, so the MXU "
+                                 "ceiling is nominal; the dots stream the "
+                                 "stack at the achieved hbm_gbps",
                    extras={"end_to_end_single_call_s": round(lone_s, 4),
                            "note": f"value = per-call time over {reps} "
                                    f"chained dispatches (the kernel is "
@@ -471,11 +530,20 @@ def bench_risk_model(smoke=False, profile=False):
 
     iters = 4
     flops = 4.0 * d * n * (k + 8) * iters  # subspace-iteration matmuls
+    # each subspace iteration streams the centered panel twice (C'Q, C Q');
+    # plus masking/centering (~2) and the loadings/idio passes (~2)
+    bytes_touched = 4.0 * ((2 * iters + 4) * d * n)
     return _result(f"risk_model_pca_{n}assets_{d}d_k{k}", seconds,
                    baseline_s=baseline_s,
                    baseline_method=f"numpy dual-Gram eigh on {nb}/{n} assets, "
                                    f"extrapolated (Gram cost linear in N)",
                    flops=flops,
+                   bytes_touched=bytes_touched,
+                   bytes_model="panel twice per subspace iteration + "
+                               "centering/loadings passes",
+                   roofline_note="k+8=28-column panel dots fill a fraction "
+                                 "of the MXU tile; the iteration streams "
+                                 "the panel at the achieved hbm_gbps",
                    extras={"end_to_end_single_call_s": round(lone_s, 4),
                            "note": f"value = per-call time over {reps} "
                                    f"chained dispatches"})
@@ -505,8 +573,9 @@ def bench_sweep(smoke=False, profile=False):
         returns=jnp.asarray(rets), cap_flag=jnp.asarray(cap),
         investability_flag=jnp.ones((d, n), jnp.float32), pct=0.1)
     fd = jnp.asarray(factors)
+    combo_batch = 16  # also feeds the traffic model below
     step = jax.jit(lambda fct, w: manager_sweep(fct, w, settings,
-                                                combo_batch=16))
+                                                combo_batch=combo_batch))
 
     with _profiled(profile, "sweep"):
         seconds = _time_fn(lambda: _fence(step(fd, cw).sharpe), repeats=2)
@@ -535,12 +604,24 @@ def bench_sweep(smoke=False, profile=False):
     baseline_s = one_combo * (d / db) * c
 
     flops = 2.0 * c * f * d * n  # the combo contraction
+    # the books stream once per combo-BATCH through the contraction, and
+    # every combo's [D, N] book + ~3 P&L passes write/read per combo
+    batches = -(-c // combo_batch)
+    bytes_touched = 4.0 * (batches * f * d * n + 4 * c * d * n)
     return _result(f"sweep_{c}combos_{f}f_{d}d_{n}assets", seconds,
                    baseline_s=baseline_s,
                    baseline_method=f"pandas multimanager for 1 combo at "
                                    f"{db}/{d} dates x{fb} managers, "
                                    f"extrapolated to {c} combos",
-                   flops=flops)
+                   flops=flops,
+                   bytes_touched=bytes_touched,
+                   bytes_model=f"books once per {combo_batch}-combo batch + "
+                               f"4 [D,N] passes per combo (contraction out "
+                               f"+ P&L)",
+                   roofline_note="per-combo [D, N] P&L passes dominate "
+                                 "traffic; the contraction is a skinny "
+                                 "[16, F] x [F, D*N] dot, so the MXU "
+                                 "ceiling is nominal")
 
 
 # ------------------------------------- rolling ops: pallas streaming vs XLA
@@ -624,6 +705,14 @@ def bench_rolling_ops(smoke=False, profile=False):
                    baseline_s=baseline_s,
                    baseline_method="the library's XLA fori-loop formulation, "
                                    "same device, chained decay+rank pairs",
+                   bytes_touched=4.0 * (4 * d * n),  # 1 read + 1 write per op
+                   bytes_model="one HBM pass in + out per op (the point of "
+                               "the streaming kernels)",
+                   roofline_note="VPU window-loop bound: W=150 compare/"
+                                 "accumulate steps per cell run in VMEM, so "
+                                 "HBM traffic is compulsory-only by design "
+                                 "and the binding resource is VPU issue "
+                                 "rate",
                    extras={"path": path,
                            "note": f"value = per-pair time over {reps} "
                                    f"chained dispatches"})
@@ -707,7 +796,15 @@ def bench_mvo_turnover(smoke=False, profile=False):
     return _result(f"mvo_turnover_backtest_{d}d_{n}assets_wallclock", seconds,
                    baseline_s=baseline_s,
                    baseline_method="reference tqdm rate 5.17 s/date "
-                                   "(pipeline.ipynb cells 41-44)")
+                                   "(pipeline.ipynb cells 41-44)",
+                   bytes_touched=4.0 * (5 * d * n),
+                   bytes_model="compulsory panels (returns/cap/signal in, "
+                               "weights/result out); ADMM matvecs are "
+                               "VMEM-resident",
+                   roofline_note="serial-dependency bound: a lax.scan of D "
+                                 "dependent days, each ~100 unrolled ADMM "
+                                 "iterations of latency-bound [T, N] "
+                                 "matvecs — neither roofline axis binds")
 
 
 # ------------------------------------- mvo_turnover at north-star scale
@@ -734,6 +831,11 @@ def bench_mvo_north_star(smoke=False, profile=False):
                    baseline_method="reference tqdm rate 5.17 s/date at 1000 "
                                    "assets (pipeline.ipynb cells 41-44); "
                                    "conservative for N=5000",
+                   bytes_touched=4.0 * (5 * d * n),
+                   bytes_model="compulsory panels; ADMM matvecs are "
+                               "VMEM-resident",
+                   roofline_note="serial-dependency bound (see the "
+                                 "wallclock config)",
                    extras={"target_s": 60.0,
                            "dates_per_s": round(d / seconds, 1)})
 
@@ -769,6 +871,11 @@ def bench_mvo_risk_model(smoke=False, profile=False):
                    baseline_method="reference tqdm rate 5.17 s/date for its "
                                    "sample-covariance MVO (no risk-model "
                                    "analog exists upstream)",
+                   bytes_touched=4.0 * (5 * d * n),
+                   bytes_model="compulsory panels; Woodbury factors are "
+                               "VMEM-resident",
+                   roofline_note="serial-dependency bound (see the "
+                                 "mvo_turnover wallclock config)",
                    extras={"dates_per_s": round(d / seconds, 1)})
 
 
@@ -871,6 +978,14 @@ def bench_north_star(smoke=False, profile=False):
         f"north_star_{n}assets_{d}d_{f}f_full_pipeline", seconds,
         baseline_s=None if smoke else 60.0,
         baseline_method="BASELINE.json <60 s target (vs_baseline > 1 passes)",
+        # per chunk: generated stack written once, read by shift/mask, sort
+        # operands w+r, sorted pair w+r (fused post-sort), blend read
+        bytes_touched=4.0 * 9 * f * d * n,
+        bytes_model="~9 passes per generated chunk (gen, mask, sort w+r x2, "
+                    "post-sort, blend)",
+        roofline_note="mix of sort-network-bound scoring (see "
+                      "rank_ic_batched) and bandwidth-bound blend; the "
+                      "dominant single op is the rank sort",
         extras={"target_s": 60.0,
                 "note": "single-pass streaming (stats + selection + blend "
                         "per chunk visit) since round 4"})
@@ -1065,8 +1180,14 @@ def main() -> None:
 
     if args.all:
         # north_star_host is excluded: its wall time varies ~5x with relay
-        # state (see its docstring) and would publish noise
+        # state (see its docstring) and would publish noise.
+        # north_star runs FIRST: the relay client's device_put leak grows
+        # process RSS with every preceding config's transfers and inflates a
+        # late north_star by ~15% (measured 4.66 s solo vs ~5.3 s after the
+        # full sequence); running it on the clean process publishes the
+        # number a fresh pipeline run actually gets.
         names = [n for n in CONFIGS if n not in EXCLUDE_FROM_ALL]
+        names.sort(key=lambda n: n != "north_star")
     else:
         names = args.configs or ["mvo_turnover"]
     results = []
