@@ -120,11 +120,12 @@ def _result(name, seconds, *, baseline_s=None, baseline_method=None,
             out["mfu_vs_bf16_peak"] = round(tflops / peak, 4)
     if bytes_touched:
         gbps = bytes_touched / seconds / 1e9
-        out["hbm_gbps"] = float(f"{gbps:.3g}")  # 3 sig figs: sub-GB/s
-        # serial-bound configs must not round to a misleading fixed decimal
+        # significant figures, not fixed decimals: serial-bound configs sit
+        # at ~1e-4 of peak and a fixed rounding would misstate them ~50%
+        out["hbm_gbps"] = float(f"{gbps:.4g}")
         peak_bw = _PEAK_HBM_GBPS.get(kind)
         if peak_bw:
-            out["hbm_frac"] = round(gbps / peak_bw, 4)
+            out["hbm_frac"] = float(f"{gbps / peak_bw:.3g}")
         if bytes_model:
             out["hbm_bytes_model"] = bytes_model
     if roofline_note:

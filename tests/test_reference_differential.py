@@ -21,15 +21,22 @@ Covered here, each against ``/root/reference``'s namesake:
   (``portfolio_simulation.py:96-181,748-797``)
 - ``run_multimanager_backtest`` (``multi_manager.py:32-100``)
 
-The mvo/mvo_turnover schemes and the mvo selector need a real QP solver on the
-reference side (cvxpy/OSQP, not installed here); their parity evidence is the
-committed OSQP-algorithm goldens in ``tests/test_qp_goldens.py``.
+- Ledoit-Wolf shrinkage + the cvxpy factor-MVO selector
+  (``factor_selection_methods.py:60-175``, the selector running on the
+  exact-QP stub from ``tools/osqp_reference``)
+- ``PortfolioAnalyzer`` metrics (``portfolio_analyzer.py:10-81``)
+- the scipy/SLSQP MVO simulation path (``portfolio_simulation.py:587-661``,
+  ``use_cvxpy=False`` — scipy IS installed, so this runs with no stub at all)
+
+The OSQP mvo/mvo_turnover scheme parity additionally lives in the committed
+goldens of ``tests/test_qp_goldens.py`` (pinned panel, exact optima).
 """
 
 import importlib
 import os
 import sys
 import types
+from pathlib import Path
 from types import SimpleNamespace
 
 import numpy as np
@@ -67,7 +74,14 @@ def ref():
     sm_api.OLS = object  # imported at operations.py:3, never called
     sm_api.add_constant = object
     sm.api = sm_api
-    cvxpy_stub = types.ModuleType("cvxpy")  # only the (untested) mvo paths call it
+    # the QP-capable cvxpy stand-in (tools/osqp_reference) at exact-optimum
+    # settings, so the reference's cvxpy selector paths run for real
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.osqp_reference import make_cvxpy_stub
+
+    cvxpy_stub = make_cvxpy_stub()
+    cvxpy_stub.set_force_settings(
+        dict(eps_abs=1e-9, eps_rel=1e-9, max_iter=40000))
 
     for name in REF_MODULES:
         sys.modules.pop(name, None)
@@ -90,7 +104,8 @@ def ref():
 @pytest.fixture(scope="module")
 def compat():
     mods = {name: importlib.import_module(f"factormodeling_tpu.compat.{name}")
-            for name in ("operations", "factor_selector", "composite_factor",
+            for name in ("operations", "factor_selector",
+                         "factor_selection_methods", "composite_factor",
                          "portfolio_simulation", "multi_manager")}
     return SimpleNamespace(**mods)
 
@@ -393,3 +408,143 @@ def test_multimanager_matches_reference(ref, compat, data):
         got_counts.sort_index().to_numpy(dtype=float),
         exp_counts.sort_index().to_numpy(dtype=float),
         atol=1e-8, rtol=0, equal_nan=True)
+
+
+# ------------------------------------------- shrinkage / selector / analyzer
+
+def test_ledoit_wolf_matches_reference(ref, data):
+    import jax.numpy as jnp
+
+    from factormodeling_tpu.selection.shrinkage import ledoit_wolf_shrinkage
+
+    rets = data.factor_ret.to_numpy()
+    exp = ref.factor_selection_methods.ledoit_wolf_shrinkage(rets)
+    got = np.asarray(ledoit_wolf_shrinkage(jnp.asarray(rets)))
+    np.testing.assert_allclose(got, exp, rtol=1e-8, atol=1e-12)
+
+
+def test_mvo_selector_matches_reference(ref, compat, data):
+    """The reference's cvxpy factor-MVO selector (running on the exact-QP
+    stub) vs the compat ADMM-backed selector — same formulation, both at the
+    optimum of a smooth strongly-convex QP."""
+    window_dates = list(data.factor_ret.index[:12])
+    factor_ret_win = data.factor_ret.loc[window_dates]
+    metrics = ref.factor_selector.single_factor_metrics(
+        data.factors.loc[window_dates], data.returns.loc[window_dates])
+    today = data.factor_ret.index[12]
+    kwargs = dict(risk_aversion=1.0, max_weight=0.6, use_shrinkage=True)
+    exp = ref.factor_selection_methods.mvo_selector(
+        metrics, None, None, factor_ret_win, today, window_dates, **kwargs)
+    got = compat.factor_selection_methods.mvo_selector(
+        metrics, None, None, factor_ret_win, today, window_dates,
+        qp_iters=4000, **kwargs)
+    got = got.reindex(exp.index)
+    assert abs(exp.sum() - 1.0) < 1e-6
+    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy(), atol=2e-4)
+
+
+def test_portfolio_analyzer_matches_reference(ref, data):
+    from factormodeling_tpu.compat.portfolio_analyzer import PortfolioAnalyzer
+
+    rng = np.random.default_rng(11)
+    dates = pd.date_range("2021-01-04", periods=140, freq="B")
+    df = pd.DataFrame({
+        "date": dates,
+        "log_return": rng.normal(1e-4, 0.01, size=len(dates)),
+        "long_return": rng.normal(0, 0.01, size=len(dates)),
+        "short_return": rng.normal(0, 0.01, size=len(dates)),
+        "long_turnover": rng.uniform(0, 0.4, len(dates)),
+        "short_turnover": rng.uniform(0, 0.4, len(dates)),
+        "turnover": rng.uniform(0, 0.8, len(dates)),
+    })
+    exp = ref.portfolio_analyzer.PortfolioAnalyzer(df.copy())
+    got = PortfolioAnalyzer(df.copy())
+    for metric in ("average_return", "daily_volatility", "yearly_volatility",
+                   "annualized_return", "sharpe_ratio", "sortino_ratio",
+                   "max_daily_return", "min_daily_return"):
+        np.testing.assert_allclose(float(getattr(got, metric)()),
+                                   float(getattr(exp, metric)()),
+                                   rtol=1e-10, err_msg=metric)
+    np.testing.assert_allclose(float(got.max_drawdown()),
+                               float(exp.max_drawdown()), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(got.max_drawdown_curve()),
+                               np.asarray(exp.max_drawdown_curve()),
+                               rtol=1e-10)
+    assert got.summary() == exp.summary()
+
+
+# --------------------------------------------- scipy (SLSQP) MVO simulation
+
+def test_simulation_mvo_scipy_path_matches_engine(ref, compat, data):
+    """The reference's OWN scipy/SLSQP MVO path (use_cvxpy=False — no stub
+    involved, scipy is installed) vs the engine's ADMM at a high-accuracy
+    budget: both reach the unique optimum of each day's smooth QP, so daily
+    weights agree tightly; acceptance below follows the QP-parity tiers."""
+    signal = data.factors["beta_long"].rename("sig")
+    exp_sim = ref.portfolio_simulation.Simulation(
+        "scipy_mvo", signal.copy(),
+        _settings(ref.portfolio_simulation, data, "mvo", use_cvxpy=False,
+                  lookback_period=12))
+    exp_sim.custom_feature = exp_sim.custom_feature * exp_sim.investability_flag
+    # pandas-3 compat: the reference's in-place covariance jitter
+    # (portfolio_simulation.py:353) hits read-only .values under
+    # copy-on-write and would silently equal-fall-back EVERY day
+    from tools.qp_goldens import _patch_fill_diagonal
+
+    orig_fill_diagonal = _patch_fill_diagonal()
+    try:
+        exp_w, exp_counts = exp_sim._daily_trade_list()
+    finally:
+        np.fill_diagonal = orig_fill_diagonal
+
+    got_sim = compat.portfolio_simulation.Simulation(
+        "scipy_mvo", signal.copy(),
+        _settings(compat.portfolio_simulation, data, "mvo",
+                  lookback_period=12, qp_iters=4000))
+    got_sim.custom_feature = (got_sim.custom_feature
+                              * got_sim.investability_flag)
+    got_w, got_counts = got_sim._daily_trade_list()
+
+    np.testing.assert_array_equal(
+        got_counts[["long_count", "short_count"]].to_numpy(),
+        exp_counts[["long_count", "short_count"]].to_numpy())
+
+    # short windows make Sigma low-rank (T << N), so the daily minimizer is
+    # NOT unique and weight-level equality is the wrong criterion; the
+    # differential statement is: on the reference's OWN covariance and
+    # constraints, our solution scores at least as well as the reference's
+    dates = sorted(set(exp_w.index.get_level_values("date")))
+    exp_dense = exp_w.unstack("symbol")
+    got_dense = got_w.reindex(exp_w.index).unstack("symbol")
+    # alignment must be real, not NaN-filled: a reindex mismatch would zero
+    # our weights and make every objective comparison below vacuous
+    pd.testing.assert_index_equal(got_dense.columns, exp_dense.columns,
+                                  exact=False)
+    assert not got_dense.iloc[2:].isna().all(axis=None)
+    orig = _patch_fill_diagonal()
+    try:
+        checked = 0
+        for t in range(2, len(dates) - 1):
+            day = dates[t]
+            x = exp_sim.custom_feature.loc[day]
+            cov = exp_sim._calculate_covariance_matrix(x.index, day)
+            if cov is None or cov.shape[0] < 2:
+                continue
+            sigma = exp_sim._apply_shrinkage(cov).to_numpy()
+            if not np.isfinite(sigma).all():
+                continue
+            we = np.nan_to_num(exp_dense.loc[dates[t + 1]].to_numpy(float))
+            wg = np.nan_to_num(got_dense.loc[dates[t + 1]].to_numpy(float))
+            # both sides must be live or flat TOGETHER, and live days must
+            # satisfy the leg constraints, before objectives are compared
+            assert (np.abs(we).sum() == 0) == (np.abs(wg).sum() == 0), day
+            if np.abs(we).sum() == 0:
+                continue
+            for w_ in (we, wg):
+                assert abs(np.where(w_ > 0, w_, 0).sum() - 1) < 1e-4
+                assert abs(np.where(w_ < 0, w_, 0).sum() + 1) < 1e-4
+            assert wg @ sigma @ wg <= we @ sigma @ we + 1e-9, day
+            checked += 1
+        assert checked >= 10, f"only {checked} solver days compared"
+    finally:
+        np.fill_diagonal = orig

@@ -196,6 +196,10 @@ def osqp_solve(P, q, A, l, u, *, max_iter=4000, eps_abs=1e-4, eps_rel=1e-4,
 class _Affine:
     """Rows of an affine map over the single decision vector w: M w + b."""
 
+    # defer numpy binary ops to our reflected methods (ndarray @ affine must
+    # reach __rmatmul__ instead of raising inside the matmul gufunc)
+    __array_ufunc__ = None
+
     def __init__(self, M, b):
         self.M = np.atleast_2d(np.asarray(M, float))
         self.b = np.atleast_1d(np.asarray(b, float))
@@ -215,6 +219,11 @@ class _Affine:
 
     def __neg__(self):
         return _Affine(-self.M, -self.b)
+
+    def __rmatmul__(self, c):
+        # numpy_vector @ affine -> scalar affine (mvo_selector's mean @ w)
+        c = np.asarray(c, float)
+        return _ScalarAffine(c @ self.M, float(c @ self.b))
 
     # comparisons build constraints (scalar rows in the reference's usage)
     def __ge__(self, c):
@@ -285,6 +294,9 @@ class _Quad:
     def __sub__(self, other):
         return _Sum([self, _negate(other)])
 
+    def __rmul__(self, c):
+        return _Quad(float(c) * self.Q)
+
 
 class _ScalarAffine:
     """A 1-row affine: an objective term, or a scalar constraint LHS
@@ -309,6 +321,12 @@ class _ScalarAffine:
     def __eq__(self, c):  # noqa: A003 - cvxpy semantics, not identity
         return self._as_affine() == c
 
+    def __add__(self, other):
+        return _Sum([self, other])
+
+    def __sub__(self, other):
+        return _Sum([self, _negate(other)])
+
     __hash__ = None
 
 
@@ -317,6 +335,10 @@ def _negate(term):
         return _L1(term.affine, -term.coef)
     if isinstance(term, _ScalarAffine):
         return _ScalarAffine(-term.row, -term.const)
+    if isinstance(term, _Quad):
+        return _Quad(-term.Q)
+    if isinstance(term, _Sum):
+        return _Sum([_negate(t) for t in term.terms])
     raise TypeError(term)
 
 
@@ -476,6 +498,14 @@ def make_cvxpy_stub():
     mod.sum = _sum
     mod.abs = _abs
     mod.multiply = multiply
+    def norm1(expr):
+        return _L1(expr)
+
+    def Maximize(expr):
+        return _Minimize(_negate(expr))
+
+    mod.norm1 = norm1
+    mod.Maximize = Maximize
     mod.Minimize = _Minimize
     mod.Problem = _Problem
     mod.OSQP = "OSQP"
