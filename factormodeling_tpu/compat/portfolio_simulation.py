@@ -217,10 +217,16 @@ class Simulation:
 
     def _calculate_metrics(self, weights: pd.Series,
                            counts: pd.DataFrame) -> pd.DataFrame:
-        """Daily-IC / turnover summary line (``portfolio_simulation.py:799``)."""
+        """Daily-IC / turnover summary frame, in the reference's exact
+        percent-scaled, 2-decimal schema (``portfolio_simulation.py:799-819``)."""
         sig, uni = self._vocab.densify(self.custom_feature)
         wv, _ = self._vocab.densify(weights)
         s = self._dense_settings(uni)
         m = _dense_signal_metrics(jnp.asarray(sig), jnp.asarray(wv), s)
-        return pd.DataFrame([{"name": self.name,
-                              **{k: float(v) for k, v in m.items()}}])
+        metrics = pd.DataFrame({
+            "IC (%)": [float(m["IC"]) * 100],
+            "IC_IR (%)": [float(m["IC_IR"]) * 100],
+            "IC_Std (%)": [float(m["IC_Std"]) * 100],
+            "Avg Turnover (%)": [float(m["Avg Turnover"]) * 100],
+        })
+        return round(metrics, 2)

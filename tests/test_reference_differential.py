@@ -18,7 +18,8 @@ Covered here, each against ``/root/reference``'s namesake:
 - ``composite_factor_calculation`` / ``weighted_composite_factor``
   (``composite_factor.py:137-342``)
 - equal/linear ``Simulation`` weights + result frames
-  (``portfolio_simulation.py:96-181,748-797``)
+  (``portfolio_simulation.py:96-181,748-797``), the ``_calculate_metrics``
+  summary frame (``:799-819``) and the contributor top-10s (``:792-795``)
 - ``run_multimanager_backtest`` (``multi_manager.py:32-100``)
 
 - Ledoit-Wolf shrinkage + the cvxpy factor-MVO selector
@@ -27,6 +28,11 @@ Covered here, each against ``/root/reference``'s namesake:
 - ``PortfolioAnalyzer`` metrics (``portfolio_analyzer.py:10-81``)
 - the scipy/SLSQP MVO simulation path (``portfolio_simulation.py:587-661``,
   ``use_cvxpy=False`` — scipy IS installed, so this runs with no stub at all)
+- the plot helpers' numerics, extracted from the rendered Line2D/patch data
+  under Agg: quantile bucket curves + L1-Sn spread
+  (``composite_factor.py:47-134``), distribution histograms (``:17-44``),
+  and every labeled dashboard line incl. the turnover display-mask quirk
+  (``portfolio_analyzer.py:83-260``)
 
 The OSQP mvo/mvo_turnover scheme parity additionally lives in the committed
 goldens of ``tests/test_qp_goldens.py`` (pinned panel, exact optima).
@@ -38,6 +44,16 @@ import sys
 import types
 from pathlib import Path
 from types import SimpleNamespace
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot  # noqa: F401 — must be imported BEFORE the ref
+# fixture's sys.modules snapshot: the reference modules import pyplot at
+# import time, and if the snapshot restore dropped a pyplot first created
+# during that import, the reference would hold a stale module instance
+# whose class identities (Path/Rectangle) break isinstance checks inside
+# any later-imported pyplot (TypeError: Invalid arguments to set_clip_path)
 
 import numpy as np
 import pandas as pd
@@ -548,3 +564,222 @@ def test_simulation_mvo_scipy_path_matches_engine(ref, compat, data):
         assert checked >= 10, f"only {checked} solver days compared"
     finally:
         np.fill_diagonal = orig
+
+
+# ----------------------------------------------- plot helpers (numerics)
+# The reference computes real numbers *inside* its matplotlib helpers
+# (quantile bucket curves, drawdown/rolling-Sharpe/turnover panels); the
+# rendered Line2D data is the only externally observable form. These tests
+# run the reference plots under Agg, extract every labeled line, and
+# assert our figures carry the same numbers.
+
+
+def _labeled_lines(fig, by_title=False):
+    """Map each labeled Line2D to its (xdata, ydata). Key is the label,
+    or (axis title, label) when the same labels repeat per axis."""
+    out = {}
+    for ax in fig.axes:
+        for ln in ax.get_lines():
+            lbl = str(ln.get_label())
+            if lbl.startswith("_"):
+                continue
+            key = (ax.get_title(), lbl) if by_title else lbl
+            assert key not in out, f"duplicate line {key}"
+            out[key] = (np.asarray(ln.get_xdata()),
+                        np.asarray(ln.get_ydata(), float))
+    return out
+
+
+def _reference_figure(plot_callable):
+    """Run a show()-style reference plot under Agg and hand back the figure
+    it left behind."""
+    import matplotlib.pyplot as plt
+
+    plt.close("all")
+    plot_callable()
+    nums = plt.get_fignums()
+    assert nums, "reference plot produced no figure"
+    fig = plt.figure(nums[-1])
+    return fig
+
+
+def _patch_legacy_resample():
+    """pandas-3 compat for the reference's resample('M') calls
+    (portfolio_analyzer.py:95): translate removed legacy aliases. Returns
+    the originals for restoration."""
+    legacy = {"M": "ME", "A": "YE", "Y": "YE"}
+    originals = (pd.DataFrame.resample, pd.Series.resample)
+
+    def _make(orig_fn):
+        def patched(self, rule=None, *args, **kwargs):
+            if isinstance(rule, str):
+                rule = legacy.get(rule, rule)
+            return orig_fn(self, rule, *args, **kwargs)
+        return patched
+
+    pd.DataFrame.resample = _make(originals[0])
+    pd.Series.resample = _make(originals[1])
+    return originals
+
+
+def test_quantile_backtest_plot_matches_reference(ref, data):
+    """plot_quantile_backtests_log (composite_factor.py:47-134): per-bucket
+    cumulative curves and the L1-Sn spread, line-for-line."""
+    import matplotlib.pyplot as plt
+
+    from factormodeling_tpu.compat.composite_factor import (
+        plot_quantile_backtests_log)
+
+    n_groups = 4
+    fac = data.factors[["alpha_eq", "gamma_flx"]]
+    rets = data.returns.fillna(0.0)  # ref drops NaN rets rows; keep both
+    # sides on one universe so the per-(date,group) means agree exactly
+
+    exp_fig = _reference_figure(
+        lambda: ref.composite_factor.plot_quantile_backtests_log(
+            fac, rets, n_groups=n_groups, ncols=2))
+    exp_lines = _labeled_lines(exp_fig, by_title=True)
+
+    got_fig = plot_quantile_backtests_log(fac, rets, n_groups=n_groups,
+                                          ncols=2)
+    got_lines = _labeled_lines(got_fig, by_title=True)
+    plt.close("all")
+
+    assert {t for t, _ in exp_lines} == {"alpha_eq", "gamma_flx"}
+    labels = [str(g) for g in range(1, n_groups + 1)] + [f"DN_L1-S{n_groups}"]
+    for title in ("alpha_eq", "gamma_flx"):
+        for lbl in labels:
+            ex, ey = exp_lines[(title, lbl)]
+            gx, gy = got_lines[(title, lbl)]
+            ex = ex.astype("datetime64[ns]")
+            gx = gx.astype("datetime64[ns]")
+            # ref only keeps dates that survive its dropna; ours is dense
+            pos = np.searchsorted(gx, ex)
+            assert (gx[pos] == ex).all(), (title, lbl)
+            np.testing.assert_allclose(
+                gy[pos], ey, atol=1e-8, rtol=0, equal_nan=True,
+                err_msg=f"{title}/{lbl}")
+
+
+def test_factor_distribution_plot_matches_reference(ref, data):
+    """plot_factor_distributions (composite_factor.py:17-44): density
+    histogram heights per factor panel."""
+    import matplotlib.pyplot as plt
+
+    from factormodeling_tpu.compat.composite_factor import (
+        plot_factor_distributions)
+
+    exp_fig = _reference_figure(
+        lambda: ref.composite_factor.plot_factor_distributions(
+            data.factors, bins=20, ncols=3))
+    got_fig = plot_factor_distributions(data.factors, bins=20, ncols=3)
+
+    def heights(fig):
+        out = {}
+        for ax in fig.axes:
+            if ax.get_title():
+                out[ax.get_title()] = np.array(
+                    [p.get_height() for p in ax.patches], float)
+        return out
+
+    exp_h, got_h = heights(exp_fig), heights(got_fig)
+    plt.close("all")
+    assert set(exp_h) == set(FACTOR_NAMES) == set(got_h)
+    for name in FACTOR_NAMES:
+        np.testing.assert_allclose(got_h[name], exp_h[name], rtol=1e-10,
+                                   err_msg=name)
+
+
+def test_dashboard_plot_matches_reference(ref):
+    """plot_full_performance (portfolio_analyzer.py:83-260): every labeled
+    line of the 6-panel dashboard — cumulative/drawdown, turnover with the
+    >1.5 display mask and its leg-zeroing quirk, counts, rolling Sharpe."""
+    import matplotlib.pyplot as plt
+
+    from factormodeling_tpu.compat.portfolio_analyzer import (
+        PortfolioAnalyzer as CompatAnalyzer)
+
+    rng = np.random.default_rng(13)
+    dates = pd.date_range("2020-01-06", periods=300, freq="B")
+    frame = pd.DataFrame({
+        "date": dates,
+        "log_return": rng.normal(2e-4, 0.01, size=len(dates)),
+        "long_return": rng.normal(0, 0.01, size=len(dates)),
+        "short_return": rng.normal(0, 0.01, size=len(dates)),
+        "long_turnover": rng.uniform(0, 0.9, len(dates)),
+        "short_turnover": rng.uniform(0, 0.9, len(dates)),
+        # some days above the 1.5 display-mask threshold, exercising the
+        # reference's "zero all three columns" quirk (:196-197)
+        "turnover": rng.uniform(0, 1.8, len(dates)),
+    })
+    counts = pd.DataFrame(
+        {"long_count": rng.integers(3, 9, len(dates)),
+         "short_count": rng.integers(3, 9, len(dates))}, index=dates)
+
+    originals = _patch_legacy_resample()
+    try:
+        exp_fig = _reference_figure(
+            lambda: ref.portfolio_analyzer.PortfolioAnalyzer(
+                frame.copy()).plot_full_performance(counts))
+    finally:
+        pd.DataFrame.resample, pd.Series.resample = originals
+    exp_lines = _labeled_lines(exp_fig)
+
+    got_fig = CompatAnalyzer(frame.copy()).plot_full_performance(counts)
+    got_lines = _labeled_lines(got_fig)
+    plt.close("all")
+
+    assert set(exp_lines) == set(got_lines)
+    # the Avg axhline's label itself asserts equality of the formatted mean
+    assert any(lbl.startswith("Avg: ") for lbl in exp_lines)
+    for lbl, (_, ey) in exp_lines.items():
+        gy = got_lines[lbl][1]
+        np.testing.assert_allclose(gy, ey, atol=1e-10, rtol=0,
+                                   equal_nan=True, err_msg=lbl)
+
+
+def test_simulation_metrics_match_reference(ref, compat, data):
+    """_calculate_metrics (portfolio_simulation.py:799-819): daily signal
+    IC / IC_IR / IC std and average turnover, as the rounded summary frame
+    the reference prints."""
+    signal = data.factors["alpha_eq"].rename("sig")
+    exp_sim = ref.portfolio_simulation.Simulation(
+        "met", signal.copy(), _settings(ref.portfolio_simulation, data,
+                                        "equal"))
+    got_sim = compat.portfolio_simulation.Simulation(
+        "met", signal.copy(), _settings(compat.portfolio_simulation, data,
+                                        "equal"))
+    for sim in (exp_sim, got_sim):
+        sim.custom_feature = sim.custom_feature * sim.investability_flag
+    exp_w, exp_c = exp_sim._daily_trade_list()
+    got_w, got_c = got_sim._daily_trade_list()
+    exp_m = exp_sim._calculate_metrics(exp_w, exp_c)
+    got_m = got_sim._calculate_metrics(got_w, got_c)
+    assert list(got_m.columns) == list(exp_m.columns)
+    np.testing.assert_allclose(got_m.to_numpy(float), exp_m.to_numpy(float),
+                               atol=1e-8, equal_nan=True)
+
+
+def test_contributor_output_matches_reference(ref, compat, data):
+    """contributor=True (portfolio_simulation.py:792-795): per-name
+    cumulative after-cost P&L, top-10 per leg."""
+    signal = data.factors["beta_long"].rename("sig")
+    exp_sim = ref.portfolio_simulation.Simulation(
+        "contrib", signal.copy(),
+        _settings(ref.portfolio_simulation, data, "linear", contributor=True))
+    got_sim = compat.portfolio_simulation.Simulation(
+        "contrib", signal.copy(),
+        _settings(compat.portfolio_simulation, data, "linear",
+                  contributor=True))
+    for sim in (exp_sim, got_sim):
+        sim.custom_feature = sim.custom_feature * sim.investability_flag
+    exp_w, _ = exp_sim._daily_trade_list()
+    got_w, _ = got_sim._daily_trade_list()
+    _, exp_long, exp_short = exp_sim._daily_portfolio_returns(exp_w)
+    _, got_long, got_short = got_sim._daily_portfolio_returns(got_w)
+    for got, exp, leg in ((got_long, exp_long, "long"),
+                          (got_short, exp_short, "short")):
+        assert list(got.index) == list(exp.index), leg
+        np.testing.assert_allclose(np.asarray(got, float),
+                                   np.asarray(exp, float), atol=1e-8,
+                                   err_msg=leg)
