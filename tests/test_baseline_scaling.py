@@ -1,0 +1,37 @@
+"""Smoke coverage for tools/baseline_scaling.py (the committed
+BASELINE_SCALING.json evidence generator): the cheap workers run at tiny
+scales and the exponent fit is exact on synthetic power laws. The heavy
+workers (composite at ~7 s/factor, risk_model's [D, D] eigh) are exercised
+only by the tool's real runs."""
+
+import numpy as np
+import pytest
+
+from tools import baseline_scaling as bs
+
+
+def test_fit_exponent_recovers_power_laws():
+    scales = np.array([10, 20, 40, 80])
+    for p in (0.5, 1.0, 2.0):
+        exp, r2 = bs.fit_exponent(scales, 0.01 * scales.astype(float) ** p)
+        assert abs(exp - p) < 1e-9
+        assert r2 > 1.0 - 1e-12
+
+
+@pytest.mark.parametrize("worker,scale", [
+    (bs.rank_ic_baseline, 8),
+    (bs.cs_ols_baseline, 8),
+    (bs.sweep_baseline, 8),
+])
+def test_cheap_workers_run(worker, scale):
+    secs = worker(scale)
+    assert secs > 0.0
+
+
+def test_run_ladder_shape():
+    out = bs.run_ladder("toy", lambda s: 0.001 * s, [2, 4, 8], "units",
+                        bench_point=2, full_scale=100)
+    assert [r["scale"] for r in out["ladder"]] == [2, 4, 8]
+    assert abs(out["fitted_exponent"] - 1.0) < 1e-6
+    assert abs(out["linear_pred_of_largest_err"]) < 1e-9
+    assert out["full_scale"] == 100
