@@ -1,0 +1,142 @@
+"""Randomized differential fuzz: hypothesis-drawn panels, the reference's
+own ops as the oracle.
+
+``test_reference_differential.py`` pins every surface on one fixed panel;
+this file drives the L2 op layer over *drawn* panels — half-integer tie
+values, drawn NaN patterns, and ragged universes (index rows dropped
+entirely, which is where pandas per-symbol gap semantics live) — and
+asserts the compat op matches the reference op at 1e-8 (x64 via conftest).
+
+Each example draws ONE (op, window/kwargs, panel) combination, so coverage
+accumulates across examples and soak runs (``FM_FUZZ_MAX=200`` etc.). The
+panel keeps date 0 and symbol S000 fully populated so the densified vocab
+shape is constant and the jit cache stays warm across examples.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from tests.test_reference_differential import (  # noqa: F401  (fixtures)
+    REFERENCE_DIR,
+    assert_series_match,
+    compat,
+    ref,
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_DIR),
+    reason="reference checkout absent (standalone deployment)")
+
+D, N = 10, 6
+_SETTINGS = dict(deadline=None,
+                 max_examples=int(os.environ.get("FM_FUZZ_MAX", 12)),
+                 suppress_health_check=[HealthCheck.too_slow])
+
+_DATES = pd.date_range("2023-01-02", periods=D, freq="B")
+_SYMBOLS = [f"S{i:03d}" for i in range(N)]
+_FULL_INDEX = pd.MultiIndex.from_product([_DATES, _SYMBOLS],
+                                         names=["date", "symbol"])
+
+# (name, kwargs-draw) for the single-input ops; windows include == and > D
+_TS_OPS = ["ts_sum", "ts_mean", "ts_std", "ts_zscore", "ts_rank", "ts_diff",
+           "ts_delay", "ts_decay", "ts_backfill"]
+_CS_OPS = ["cs_rank", "cs_winsor", "cs_filter_center", "cs_zscore", "cs_mean",
+           "market_neutralize"]
+_GROUP_OPS = ["group_mean", "group_neutralize", "group_normalize",
+              "group_rank_normalized"]
+
+
+@st.composite
+def long_panel(draw, extra_cols=0):
+    """A drawn long-format panel: half-integer ties, NaNs, ragged rows."""
+    def column():
+        vals = draw(st.lists(st.integers(-4, 4), min_size=D * N,
+                             max_size=D * N))
+        x = np.asarray(vals, np.float64) / 2.0
+        nan_mask = np.asarray(draw(st.lists(
+            st.booleans(), min_size=D * N, max_size=D * N)))
+        x[nan_mask & (np.arange(D * N) % 3 > 0)] = np.nan
+        return x
+
+    cols = [column() for _ in range(1 + extra_cols)]
+    # ragged universe: drop drawn rows, but keep date 0 and symbol S000
+    # complete so the densified shape (and the jit cache) is stable
+    drop = np.asarray(draw(st.lists(st.sampled_from([False, False, True]),
+                                    min_size=D * N, max_size=D * N)))
+    dates = _FULL_INDEX.get_level_values("date")
+    syms = _FULL_INDEX.get_level_values("symbol")
+    drop &= ~((dates == _DATES[0]) | (syms == _SYMBOLS[0]))
+    keep = ~drop
+    idx = _FULL_INDEX[keep]
+    return [pd.Series(c[keep], index=idx, name=f"c{i}")
+            for i, c in enumerate(cols)]
+
+
+@settings(**_SETTINGS)
+@given(data=long_panel(), op=st.sampled_from(_TS_OPS),
+       window=st.sampled_from([1, 3, 7, 10, 12]))
+def test_fuzz_ts_ops_match_reference(ref, compat, data, op, window):
+    (x,) = data
+    if op == "ts_backfill":
+        exp = ref.operations.ts_backfill(x)
+        got = compat.operations.ts_backfill(x)
+    else:
+        exp = getattr(ref.operations, op)(x, window)
+        got = getattr(compat.operations, op)(x, window)
+    assert_series_match(got, exp, what=f"{op} w={window}")
+
+
+@settings(**_SETTINGS)
+@given(data=long_panel(), op=st.sampled_from(_CS_OPS))
+def test_fuzz_cs_ops_match_reference(ref, compat, data, op):
+    (x,) = data
+    exp = getattr(ref.operations, op)(x)
+    got = getattr(compat.operations, op)(x)
+    assert_series_match(got, exp, what=op)
+
+
+@settings(**_SETTINGS)
+@given(data=long_panel(), op=st.sampled_from(_GROUP_OPS),
+       labels=st.lists(st.sampled_from(["tech", "fin", "health"]),
+                       min_size=D * N, max_size=D * N))
+def test_fuzz_group_ops_match_reference(ref, compat, data, op, labels):
+    (x,) = data
+    groups = pd.Series(np.asarray(labels, object)[:len(x)], index=x.index)
+    exp = getattr(ref.operations, op)(x, groups)
+    got = getattr(compat.operations, op)(x, groups)
+    assert_series_match(got, exp, what=op)
+
+
+@settings(**_SETTINGS)
+@given(data=long_panel(extra_cols=1),
+       rettype=st.sampled_from([0, 1, 2, 3, 6]),
+       window=st.sampled_from([3, 7]))
+def test_fuzz_ts_regression_matches_reference(ref, compat, data, rettype,
+                                              window):
+    y, x = data
+    exp = ref.operations.ts_regression_fast(y, x, window, rettype=rettype,
+                                            lag=0)
+    got = compat.operations.ts_regression_fast(y, x, window, rettype=rettype,
+                                               lag=0)
+    # index contract documented at test_ts_regression_fast_matches_reference:
+    # the reference emits only defined entries (per-symbol dropna concat),
+    # compat aligns to y.index with NaN elsewhere
+    assert_series_match(got.dropna(), exp.dropna(), atol=1e-7,
+                        what=f"rettype={rettype}")
+    extra = got[~got.index.isin(exp.index)]
+    assert extra.isna().all()
+
+
+@settings(**_SETTINGS)
+@given(data=long_panel(extra_cols=1),
+       rettype=st.sampled_from(["resid", "beta", "alpha", "fitted", "r2"]))
+def test_fuzz_cs_regression_matches_reference(ref, compat, data, rettype):
+    y, x = data
+    exp = ref.operations.cs_regression(y, x, rettype=rettype)
+    got = compat.operations.cs_regression(y, x, rettype=rettype)
+    assert_series_match(got, exp, atol=1e-7, what=f"rettype={rettype}")

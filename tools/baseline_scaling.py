@@ -234,7 +234,7 @@ def main():
     results["rank_ic_batched"] = run_ladder(
         "rank_ic", rank_ic_baseline,
         [100, 300, 900] if q else [100, 300, 900, 2700],
-        "factor-dates", 100, 50400)
+        "factor-dates", "900/2700 marginal rate", 50400)
 
     print("cs_ols baseline (loop axis: dates)")
     results["cs_ols"] = run_ladder(
@@ -251,14 +251,14 @@ def main():
           "loop-repeats of the measured block by construction)")
     results["sweep"] = run_ladder(
         "sweep", sweep_baseline,
-        [40, 80] if q else [40, 80, 160, 320], "dates", 40, 2520)
+        [40, 80] if q else [40, 80, 160, 320], "dates", 160, 2520)
 
     print("risk_model baseline (axis: assets — includes FULL scale)")
     parts: dict = {}
     results["risk_model"] = run_ladder(
         "risk_model", lambda nb: risk_model_baseline(nb, parts),
         [625, 1250, 2500] if q else [625, 1250, 2500, 5000],
-        "assets", 1250, 5000,
+        "assets", "5000 (full scale, measured directly)", 5000,
         extras={"stage_breakdown": parts,
                 "note": "eigh of the [D,D] Gram is constant in N, so the "
                         "block is sublinear; the full-N=5000 row is the "
