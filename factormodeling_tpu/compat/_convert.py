@@ -13,14 +13,19 @@ import numpy as np
 import pandas as pd
 import jax.numpy as jnp
 
+from factormodeling_tpu.panel import _index_level
+
 __all__ = ["PanelVocab", "level_values"]
 
 
 def level_values(index: pd.MultiIndex, name: str, position: int) -> pd.Index:
-    """A named MultiIndex level, falling back to position for unnamed levels."""
-    if name in (index.names or []):
-        return index.get_level_values(name)
-    return index.get_level_values(position)
+    """A named MultiIndex level, falling back to position only when the
+    positional level is unnamed; flat indexes and named-but-mismatched
+    levels raise with the (date, symbol) contract spelled out — the
+    reference's own ``groupby(level="symbol")`` calls would KeyError on
+    those too, just less helpfully. One implementation, shared with the
+    L1 ingestion path (``panel._index_level``)."""
+    return _index_level(index, name, position)
 
 
 class PanelVocab:

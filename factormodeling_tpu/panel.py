@@ -51,10 +51,28 @@ def _as_np_vocab(x) -> np.ndarray:
 
 
 def _index_level(index, name: str, position: int):
-    """A MultiIndex level by name, falling back to position when unnamed —
-    so a (symbol, date)-ordered index with named levels is NOT transposed."""
+    """A MultiIndex level by name, falling back to position ONLY when the
+    positional level is unnamed — so a (symbol, date)-ordered index with
+    named levels is never silently transposed, and contract violations
+    raise with the (date, symbol) expectation spelled out instead of
+    pandas' opaque level errors. Shared by the compat layer
+    (``compat/_convert.level_values``)."""
+    import pandas as pd
+
+    if not isinstance(index, pd.MultiIndex):
+        raise TypeError(
+            f"expected a (date, symbol)-MultiIndexed pandas object (the "
+            f"reference's L1 data model); got a flat "
+            f"{type(index).__name__} — see docs/migration.md")
     if name in (index.names or []):
         return index.get_level_values(name)
+    pos_name = None if index.names is None else index.names[position]
+    if pos_name is not None:
+        raise KeyError(
+            f"MultiIndex level {name!r} not found (levels: "
+            f"{list(index.names)}); levels resolve by the reference's "
+            f"names ('date', 'symbol'), with a positional fallback only "
+            f"for unnamed levels")
     return index.get_level_values(position)
 
 

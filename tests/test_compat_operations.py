@@ -146,3 +146,43 @@ def test_ts_regression(rng, rettype):
     x = x.reindex(y.index)
     assert_series_match(cop.ts_regression_fast(y, x, 5, rettype=rettype),
                         po.o_ts_regression(y, x, 5, rettype=rettype))
+
+
+def test_index_contract_errors_are_clear():
+    """Flat indexes and fully-named levels missing date/symbol raise with
+    the (date, symbol) contract spelled out; unnamed levels fall back to
+    position (compat/_convert.level_values)."""
+    with pytest.raises(TypeError, match=r"\(date, symbol\)-MultiIndexed"):
+        cop.cs_rank(pd.Series([1.0, 2.0, 3.0]))
+
+    bad = pd.MultiIndex.from_product([["a", "b"], ["x", "y"]],
+                                     names=["foo", "bar"])
+    with pytest.raises(KeyError, match="level 'date' not found"):
+        cop.cs_rank(pd.Series([1.0, 2.0, 3.0, 4.0], index=bad))
+
+    unnamed = pd.MultiIndex.from_product(
+        [pd.to_datetime(["2021-01-04"]), ["x", "y"]])
+    out = cop.cs_rank(pd.Series([1.0, 2.0], index=unnamed))
+    np.testing.assert_allclose(out.to_numpy(), [0.0, 1.0])
+
+    partial = pd.MultiIndex.from_product(
+        [pd.to_datetime(["2021-01-04"]), ["x", "y"]], names=["date", None])
+    out = cop.cs_rank(pd.Series([2.0, 1.0], index=partial))
+    np.testing.assert_allclose(out.to_numpy(), [1.0, 0.0])
+
+
+def test_partially_named_mismatched_index_raises():
+    """names=['symbol', None]: 'date' must NOT fall back positionally onto
+    the named symbol level (it would silently transpose the panel)."""
+    bad = pd.MultiIndex.from_product(
+        [["x", "y"], pd.to_datetime(["2021-01-04"])], names=["symbol", None])
+    with pytest.raises(KeyError, match="level 'date' not found"):
+        cop.cs_rank(pd.Series([1.0, 2.0], index=bad))
+
+
+def test_panel_ingestion_shares_index_contract():
+    """Panel.from_series goes through the same guarded level resolution."""
+    from factormodeling_tpu.panel import Panel
+
+    with pytest.raises(TypeError, match=r"\(date, symbol\)-MultiIndexed"):
+        Panel.from_series(pd.Series([1.0, 2.0, 3.0]))
