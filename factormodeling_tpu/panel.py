@@ -64,10 +64,9 @@ def _index_level(index, name: str, position: int):
             f"expected a (date, symbol)-MultiIndexed pandas object (the "
             f"reference's L1 data model); got a flat "
             f"{type(index).__name__} — see docs/migration.md")
-    if name in (index.names or []):
+    if name in index.names:
         return index.get_level_values(name)
-    pos_name = None if index.names is None else index.names[position]
-    if pos_name is not None:
+    if position >= index.nlevels or index.names[position] is not None:
         raise KeyError(
             f"MultiIndex level {name!r} not found (levels: "
             f"{list(index.names)}); levels resolve by the reference's "
