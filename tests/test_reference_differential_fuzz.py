@@ -32,15 +32,19 @@ pytestmark = pytest.mark.skipif(
     not os.path.isdir(REFERENCE_DIR),
     reason="reference checkout absent (standalone deployment)")
 
-D, N = 10, 6
+# two fixed shapes (one N>D) so the jit cache stays warm while both
+# aspect ratios and their padding paths get exercised
+_SHAPES = ((10, 6), (6, 11))
 _SETTINGS = dict(deadline=None,
                  max_examples=int(os.environ.get("FM_FUZZ_MAX", 12)),
                  suppress_health_check=[HealthCheck.too_slow])
 
-_DATES = pd.date_range("2023-01-02", periods=D, freq="B")
-_SYMBOLS = [f"S{i:03d}" for i in range(N)]
-_FULL_INDEX = pd.MultiIndex.from_product([_DATES, _SYMBOLS],
-                                         names=["date", "symbol"])
+
+def _full_index(d, n):
+    dates = pd.date_range("2023-01-02", periods=d, freq="B")
+    symbols = [f"S{i:03d}" for i in range(n)]
+    return pd.MultiIndex.from_product([dates, symbols],
+                                      names=["date", "symbol"])
 
 # (name, kwargs-draw) for the single-input ops; windows include == and > D
 _TS_OPS = ["ts_sum", "ts_mean", "ts_std", "ts_zscore", "ts_rank", "ts_diff",
@@ -54,25 +58,28 @@ _GROUP_OPS = ["group_mean", "group_neutralize", "group_normalize",
 @st.composite
 def long_panel(draw, extra_cols=0):
     """A drawn long-format panel: half-integer ties, NaNs, ragged rows."""
+    d, n = draw(st.sampled_from(_SHAPES))
+    full_index = _full_index(d, n)
+
     def column():
-        vals = draw(st.lists(st.integers(-4, 4), min_size=D * N,
-                             max_size=D * N))
+        vals = draw(st.lists(st.integers(-4, 4), min_size=d * n,
+                             max_size=d * n))
         x = np.asarray(vals, np.float64) / 2.0
         nan_mask = np.asarray(draw(st.lists(
-            st.booleans(), min_size=D * N, max_size=D * N)))
-        x[nan_mask & (np.arange(D * N) % 3 > 0)] = np.nan
+            st.booleans(), min_size=d * n, max_size=d * n)))
+        x[nan_mask & (np.arange(d * n) % 3 > 0)] = np.nan
         return x
 
     cols = [column() for _ in range(1 + extra_cols)]
     # ragged universe: drop drawn rows, but keep date 0 and symbol S000
     # complete so the densified shape (and the jit cache) is stable
     drop = np.asarray(draw(st.lists(st.sampled_from([False, False, True]),
-                                    min_size=D * N, max_size=D * N)))
-    dates = _FULL_INDEX.get_level_values("date")
-    syms = _FULL_INDEX.get_level_values("symbol")
-    drop &= ~((dates == _DATES[0]) | (syms == _SYMBOLS[0]))
+                                    min_size=d * n, max_size=d * n)))
+    dates = full_index.get_level_values("date")
+    syms = full_index.get_level_values("symbol")
+    drop &= ~((dates == dates[0]) | (syms == "S000"))
     keep = ~drop
-    idx = _FULL_INDEX[keep]
+    idx = full_index[keep]
     return [pd.Series(c[keep], index=idx, name=f"c{i}")
             for i, c in enumerate(cols)]
 
@@ -103,7 +110,8 @@ def test_fuzz_cs_ops_match_reference(ref, compat, data, op):
 @settings(**_SETTINGS)
 @given(data=long_panel(), op=st.sampled_from(_GROUP_OPS),
        labels=st.lists(st.sampled_from(["tech", "fin", "health"]),
-                       min_size=D * N, max_size=D * N))
+                       min_size=max(d * n for d, n in _SHAPES),
+                       max_size=max(d * n for d, n in _SHAPES)))
 def test_fuzz_group_ops_match_reference(ref, compat, data, op, labels):
     (x,) = data
     groups = pd.Series(np.asarray(labels, object)[:len(x)], index=x.index)
