@@ -37,8 +37,29 @@ def normalize_legs(w: jnp.ndarray) -> jnp.ndarray:
     return wp + wn
 
 
+def _asc_rank(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """0-based ascending rank among masked cells; ties keep first-index
+    order (stable). The reference's short leg (``sort_values()``,
+    ``portfolio_simulation.py:162``) also defaults to quicksort, so its
+    exact-tie order is implementation-defined just like the long leg's —
+    the documented divergence at :func:`_desc_rank` covers BOTH legs; the
+    stable rule here is the deterministic contract."""
+    keyed = jnp.where(mask, values, jnp.inf)
+    order = jnp.argsort(keyed, axis=_N_AXIS, stable=True)
+    return jnp.argsort(order, axis=_N_AXIS, stable=True)
+
+
 def _desc_rank(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    """0-based descending rank among masked cells (stable on ties)."""
+    """0-based descending rank among masked cells; ties keep first-index
+    order (stable). DOCUMENTED DIVERGENCE (both legs): the reference sorts
+    each leg with pandas ``sort_values`` at the default quicksort
+    (``portfolio_simulation.py:161-162``), whose exact-tie order is
+    numpy-implementation-defined — measured on this numpy, descending
+    [0.5, 1, 1] ties come out first-index but [0.5, 0.5, 1, 1] last-index.
+    An exactly-tied signal at the top-k boundary is therefore not a
+    reproducible reference contract; these kernels use the stable
+    first-index rule (the same one pandas ``nlargest`` documents) so the
+    selection is deterministic across runs and numpy versions."""
     keyed = jnp.where(mask, values, -jnp.inf)
     order = jnp.argsort(-keyed, axis=_N_AXIS, stable=True)
     return jnp.argsort(order, axis=_N_AXIS, stable=True)
@@ -57,7 +78,7 @@ def equal_weights(signal: jnp.ndarray, pct: float):
     k_short = jnp.maximum(jnp.floor(cn * pct), 1.0).astype(jnp.int32)
 
     rl = _desc_rank(signal, pos)
-    rs = _desc_rank(-signal, neg)
+    rs = _asc_rank(signal, neg)
     sel_long = pos & (rl < k_long[..., None])
     sel_short = neg & (rs < k_short[..., None])
     w = sel_long.astype(signal.dtype) - sel_short.astype(signal.dtype)

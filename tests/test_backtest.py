@@ -423,3 +423,17 @@ def test_risk_model_partial_history_refit_not_deflated(rng):
                                atol=1e-6)
     np.testing.assert_allclose(np.asarray(idio_s[1]),
                                np.asarray(direct.idio_var), rtol=1e-6)
+
+
+def test_equal_scheme_tie_rule_is_deterministic_first_index():
+    """Ties at the top-k boundary select the FIRST index (stable rule,
+    pandas-nlargest semantics). The reference's own tie order there is
+    numpy-quicksort-implementation-defined (see backtest/weights.py:
+    _desc_rank) — this pins OUR deterministic contract for both legs."""
+    from factormodeling_tpu.backtest.weights import equal_weights
+
+    sig = jnp.array([[0.5, 1.0, 1.0, -0.5, -1.0, -1.0]])
+    w, lc, sc = equal_weights(sig, pct=0.1)  # k = max(floor(.3), 1) = 1
+    w = np.asarray(w[0])
+    assert lc[0] == 1 and sc[0] == 1
+    np.testing.assert_allclose(w, [0.0, 1.0, 0.0, 0.0, -1.0, 0.0])

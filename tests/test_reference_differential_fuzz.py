@@ -18,7 +18,7 @@ import os
 import numpy as np
 import pandas as pd
 import pytest
-from hypothesis import HealthCheck, given, settings
+from hypothesis import HealthCheck, assume, given, settings
 from hypothesis import strategies as st
 
 from tests.test_reference_differential import (  # noqa: F401  (fixtures)
@@ -148,3 +148,82 @@ def test_fuzz_cs_regression_matches_reference(ref, compat, data, rettype):
     exp = ref.operations.cs_regression(y, x, rettype=rettype)
     got = compat.operations.cs_regression(y, x, rettype=rettype)
     assert_series_match(got, exp, atol=1e-7, what=f"rettype={rettype}")
+
+
+@settings(deadline=None,
+          max_examples=int(os.environ.get("FM_FUZZ_MAX", 8)),
+          suppress_health_check=[HealthCheck.too_slow,
+                                 HealthCheck.filter_too_much])
+@given(data=long_panel(extra_cols=1),
+       method=st.sampled_from(["equal", "linear"]),
+       pct=st.sampled_from([0.1, 0.3, 0.5]),
+       caps=st.lists(st.sampled_from([1.0, 2.0, 3.0]),
+                     min_size=max(d * n for d, n in _SHAPES),
+                     max_size=max(d * n for d, n in _SHAPES)))
+def test_fuzz_simulation_matches_reference(ref, compat, data, method, pct,
+                                           caps):
+    """Drawn signals through the weight pipeline: the equal scheme's
+    floor(pct*n)-min-1 top-k legs, the linear scheme's
+    cap-and-redistribute loop, and the tiered-t-cost P&L, differentially
+    vs the reference Simulation.
+
+    Ties at the top-k boundary are broken with a tiny per-symbol epsilon
+    BEFORE both sides run: the reference's own tie order there is
+    numpy-quicksort-implementation-defined (pandas sort_values
+    (ascending=False) ties measure first-index for [.5, 1, 1] but
+    last-index for [.5, .5, 1, 1] on this numpy), so exact-tie selection
+    is not a reproducible reference contract — see the documented
+    divergence at backtest/weights.py:_desc_rank and
+    test_backtest's deterministic tie-rule test."""
+    sig, rets_raw = data
+    # multiplicative: preserves zeros (flat names), signs (leg membership),
+    # and NaN, while splitting exact ties among nonzero values
+    eps = pd.Series(1e-9 * (1 + np.arange(len(sig)) % 97), index=sig.index)
+    sig = sig * (1.0 + eps)
+    rets = (rets_raw * 0.02).rename("log_return")
+    cap = pd.Series(np.asarray(caps)[:len(sig)], index=sig.index,
+                    name="cap_flag")
+    invest = pd.Series(1.0, index=sig.index, name="investability_flag")
+
+    def settings_for(mod):
+        return mod.SimulationSettings(
+            returns=rets, cap_flag=cap, investability_flag=invest,
+            factors_df=pd.DataFrame(index=sig.index), method=method,
+            pct=pct, max_weight=0.35, plot=False, output_returns=True)
+
+    exp_sim = ref.portfolio_simulation.Simulation(
+        "fuzz", sig.copy(), settings_for(ref.portfolio_simulation))
+    got_sim = compat.portfolio_simulation.Simulation(
+        "fuzz", sig.copy(), settings_for(compat.portfolio_simulation))
+    for sim in (exp_sim, got_sim):
+        sim.custom_feature = sim.custom_feature * sim.investability_flag
+    try:
+        exp_w, exp_c = exp_sim._daily_trade_list()
+        exp_res = exp_sim._daily_portfolio_returns(exp_w)[0]
+    except Exception:
+        # The reference itself crashes on some drawn panels under pandas 3
+        # (copy-on-write block-manager IndexError inside its frame
+        # mutations — layout-dependent, e.g. flat signals). No reference
+        # output exists to differ against; ours must still complete
+        # cleanly before the example is discarded.
+        got_w, _ = got_sim._daily_trade_list()
+        got_sim._daily_portfolio_returns(got_w)
+        assume(False)
+    got_w, got_c = got_sim._daily_trade_list()
+
+    np.testing.assert_array_equal(
+        got_c[["long_count", "short_count"]].to_numpy(),
+        exp_c[["long_count", "short_count"]].to_numpy())
+    # same convention as the fixed-panel differential: index equality plus
+    # NaN-respecting value equality (day-0 shifted weights are NaN on both
+    # sides)
+    assert_series_match(got_w.rename("w"), exp_w.rename("w"),
+                        what=f"{method} pct={pct}")
+
+    got_res = got_sim._daily_portfolio_returns(got_w)[0]
+    for col in ["log_return", "long_return", "short_return", "long_turnover",
+                "short_turnover", "turnover"]:
+        np.testing.assert_allclose(
+            got_res.sort_values("date")[col].to_numpy(),
+            exp_res.sort_values("date")[col].to_numpy(),
+            atol=1e-8, rtol=0, equal_nan=True, err_msg=col)
