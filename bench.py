@@ -313,9 +313,13 @@ def bench_rank_ic_batched(smoke=False, profile=False):
                                "pair w, fused Pallas post-sort r",
                    roofline_note="sort-comparator-network bound: the "
                                  "unstable 2-operand lax.sort is ~80% of "
-                                 "device time and sits within ~2x of the "
-                                 "VPU ceiling for a bitonic network (see "
-                                 "docs/architecture.md round-4 notes); "
+                                 "device time and sits within ~1.2-1.3x "
+                                 "of the measured VPU floor for ANY exact "
+                                 "comparison network at this shape — the "
+                                 "round-5 fused Pallas bitonic measured "
+                                 "at parity and the non-comparison "
+                                 "escapes are structurally blocked on "
+                                 "TPU (docs/architecture.md section 11); "
                                  "neither MXU nor HBM is the binding "
                                  "resource",
                    extras={"gcells_per_s": round(cells / seconds / 1e9, 2),

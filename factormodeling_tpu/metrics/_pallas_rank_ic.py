@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from factormodeling_tpu.ops._pallas_window import pallas_available, pltpu
+from factormodeling_tpu.ops._pallas_window import (pallas_available, pltpu,
+                                                   tpu_compiler_params)
 
 __all__ = ["pallas_available", "rank_ic_postsort"]
 
@@ -122,9 +123,8 @@ def rank_ic_postsort(s_key: jnp.ndarray, r_s: jnp.ndarray, *,
         # ~8 live [M, 128] f32 temporaries (keys, payload, two scan states
         # and their shifted copies, deviations) exceed the 16 MB default
         # scoped-vmem budget at M=5000; the v5e core has 128 MB
-        params = getattr(pltpu, "CompilerParams", None) or getattr(
-            pltpu, "TPUCompilerParams")
-        kwargs["compiler_params"] = params(vmem_limit_bytes=96 * 1024 * 1024)
+        kwargs["compiler_params"] = tpu_compiler_params(
+            vmem_limit_bytes=96 * 1024 * 1024)
     out = pl.pallas_call(
         functools.partial(_kernel, m=m),
         grid=(nblk,),

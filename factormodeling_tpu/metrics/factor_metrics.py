@@ -78,15 +78,29 @@ def _rank_ic(f: jnp.ndarray, r: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     ~180 ms, everything else ~100 ms, vs ~260 + ~120 for the round-3 stable
     sort + generic masked-Pearson version.
     """
+    import os
+
     from jax import lax
 
     key = jnp.where(valid, f, jnp.nan)
     rr = jnp.broadcast_to(jnp.where(valid, r, 0.0), key.shape)
-    s_key, r_s = lax.sort((key, rr), dimension=key.ndim - 1, num_keys=1,
-                          is_stable=False)
 
     n = key.shape[-1]
     from factormodeling_tpu.metrics import _pallas_rank_ic as _pri
+
+    if os.environ.get("FM_RANK_IC_FUSED") == "1":
+        # opt-in fully-fused sort+rank+moments kernel: measured at parity
+        # with the XLA-sort path on v5e (see _pallas_rank_sort.py); kept
+        # dispatchable for wider-VPU chips
+        from factormodeling_tpu.metrics import _pallas_rank_sort as _prs
+
+        if (_prs.pallas_available() and key.dtype == jnp.float32
+                and rr.dtype == jnp.float32 and 128 <= n <= _prs.MAX_WIDTH):
+            ic, _ = _prs.rank_ic_fused(key.reshape(-1, n), rr.reshape(-1, n))
+            return ic.reshape(key.shape[:-1])
+
+    s_key, r_s = lax.sort((key, rr), dimension=key.ndim - 1, num_keys=1,
+                          is_stable=False)
 
     if (_pri.pallas_available() and key.dtype == jnp.float32
             and r_s.dtype == jnp.float32

@@ -31,9 +31,18 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 __all__ = ["decay_streaming", "ts_rank_streaming", "ts_std_streaming",
-           "ts_zscore_streaming", "pallas_available"]
+           "ts_zscore_streaming", "pallas_available", "tpu_compiler_params"]
 
 _LANES = 128
+
+
+def tpu_compiler_params(**kwargs):
+    """Version-compat shim for the Mosaic compiler-params class (renamed
+    ``TPUCompilerParams`` -> ``CompilerParams`` across JAX releases); the
+    single home for every kernel that needs e.g. ``vmem_limit_bytes``."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
 
 
 def pallas_available() -> bool:

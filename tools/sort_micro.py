@@ -1,0 +1,111 @@
+"""Microbenchmarks for the rank-IC sort bottleneck (round 5 task 1).
+
+Measures, at the rank_ic_batched shape (10x5040x5000 -> rows 50400 x 5000):
+  a. 2-operand unstable lax.sort (the current formulation)
+  b. 1-operand unstable lax.sort (key only)
+  c. chunked sort: view rows as [R, C, n/C] and sort the last axis
+  d. current full rank_ic path for context
+
+Run: python tools/sort_micro.py
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _fence(out):
+    """Materialize a scalar depending on the output — block_until_ready can
+    return early on tunneled backends (see bench.py)."""
+    leaves = jax.tree_util.tree_leaves(out)
+    s = 0.0
+    for a in leaves:
+        s += float(jnp.ravel(a)[:8].sum())
+    return s
+
+
+def timeit(fn, *args, reps=5):
+    _fence(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _fence(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    rows, n = 50400, 5000
+    rng = np.random.default_rng(0)
+    key = rng.normal(size=(rows, n)).astype(np.float32)
+    key[rng.uniform(size=key.shape) < 0.03] = np.nan
+    pay = rng.normal(size=(rows, n)).astype(np.float32)
+    kd, pd = jnp.asarray(key), jnp.asarray(pay)
+
+    @jax.jit
+    def sort2(k, p):
+        return lax.sort((k, p), dimension=1, num_keys=1, is_stable=False)
+
+    @jax.jit
+    def sort1(k):
+        return lax.sort((k,), dimension=1, num_keys=1, is_stable=False)
+
+    @jax.jit
+    def sort1_stable(k):
+        return lax.sort((k,), dimension=1, num_keys=1, is_stable=True)
+
+    @jax.jit
+    def sort2_int_payload(k):
+        iota = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), k.shape)
+        return lax.sort((k, iota), dimension=1, num_keys=1, is_stable=False)
+
+    print("sort2 (key+payload):", timeit(sort2, kd, pd))
+    print("sort1 (key only):   ", timeit(sort1, kd))
+    print("sort1 stable:       ", timeit(sort1_stable, kd))
+    print("sort2 int payload:  ", timeit(sort2_int_payload, kd))
+
+    # chunked: sort C chunks of width n/C each (for a merge-based scheme)
+    for c in (4, 8, 16):
+        w = n // c  # 5000 divisible by 4, 8; for 16 use 312*16=4992 approx
+        if n % c:
+            continue
+
+        @jax.jit
+        def sortc(k, p, c=c, w=w):
+            kk = k.reshape(rows, c, w)
+            pp = p.reshape(rows, c, w)
+            return lax.sort((kk, pp), dimension=2, num_keys=1, is_stable=False)
+
+        print(f"sort2 chunked c={c} (w={w}):", timeit(sortc, kd, pd))
+
+    # padded pow2 width, for reference
+    kp = jnp.pad(kd, ((0, 0), (0, 8192 - n)), constant_values=np.nan)
+    pp = jnp.pad(pd, ((0, 0), (0, 8192 - n)))
+
+    @jax.jit
+    def sort2_pad(k, p):
+        return lax.sort((k, p), dimension=1, num_keys=1, is_stable=False)
+
+    print("sort2 padded 8192:  ", timeit(sort2_pad, kp, pp))
+
+    k2 = jnp.pad(kd, ((0, 0), (0, 120)), constant_values=np.nan)
+    p2 = jnp.pad(pd, ((0, 0), (0, 120)))
+    print("sort2 padded 5120:  ", timeit(sort2_pad, k2, p2))
+
+    # current full path at bench shape
+    from factormodeling_tpu.metrics import daily_factor_stats
+
+    f, d = 10, 5040
+    fd = jnp.asarray(key.reshape(f, d, n))
+    rd = jnp.asarray(pay.reshape(f, d, n)[0])
+    step = jax.jit(lambda ff, r: daily_factor_stats(
+        ff, r, shift_periods=1, stats=("rank_ic",))["rank_ic"])
+    print("full rank_ic path:  ", timeit(step, fd, rd))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Probe achieved VPU throughput: K dependent elementwise passes over
+[G,B,L] f32 VMEM data, same layout as the sort kernel."""
+import functools, time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from factormodeling_tpu.ops._pallas_window import tpu_compiler_params
+
+def _kernel(x_ref, o_ref, *, k):
+    x = x_ref[...]
+    for i in range(k):
+        x = x * 1.0000001 + 0.5   # fused multiply-add: 1 VPU op-ish
+    o_ref[...] = x
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def probe(x, k):
+    G, R, L = x.shape
+    return pl.pallas_call(
+        functools.partial(_kernel, k=k),
+        grid=(R // 32,),
+        in_specs=[pl.BlockSpec((G, 32, L), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((G, 32, L), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=100*1024*1024),
+    )(x)
+
+def _fence(o):
+    return float(jnp.ravel(o)[:8].sum())
+
+x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 50400, 128)).astype(np.float32))
+for k in (64, 256):
+    _fence(probe(x, k))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); _fence(probe(x, k)); ts.append(time.perf_counter()-t0)
+    t = min(ts)
+    ops = x.size * k
+    print(f"k={k}: {t:.4f}s -> {ops/t/1e12:.2f} Tops/s (fma counted as 1)")
