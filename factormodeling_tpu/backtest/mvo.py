@@ -44,7 +44,8 @@ from jax import lax
 
 from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.backtest.weights import equal_weights, leg_masks
-from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_lowrank
+from factormodeling_tpu.solvers import (ADMMWarmState, BoxQPProblem,
+                                        admm_solve_lowrank)
 from factormodeling_tpu.solvers.portfolio import (
     equal_leg_fallback as _x0_legs,
     leg_constraints,
@@ -87,7 +88,8 @@ def _shrunk_terms(c: jnp.ndarray, t_used, lam: float, dtype):
 
 
 def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
-               s: SimulationSettings, turnover: bool, risk_model=None):
+               s: SimulationSettings, turnover: bool, risk_model=None,
+               warm: ADMMWarmState | None = None):
     """One date's MVO solve with the full fallback ladder.
 
     ``risk_model``: optional ``(loadings [N, k], factor_var [k], idio [N],
@@ -97,9 +99,14 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     rows behind the fit, driving the ladder in place of the sample window's
     ``t_used``). ``None`` -> the reference's trailing sample covariance.
 
-    Returns ``(w [N], primal_residual [], solver_ok [])`` — the residual and
-    acceptance flag feed :class:`~factormodeling_tpu.backtest.diagnostics.
-    SolverDiagnostics`."""
+    ``warm``: optional (z, u, rho) from a previous related solve — the
+    day-over-day carry mirroring the reference's persistent OSQP warm start
+    (``portfolio_simulation.py:427-437``).
+
+    Returns ``(w [N], primal_residual [], solver_ok [], warm_state)`` — the
+    residual and acceptance flag feed :class:`~factormodeling_tpu.backtest.
+    diagnostics.SolverDiagnostics`; ``warm_state`` is the exit iterate for
+    the next day's carry."""
     n = signal_row.shape[0]
     dtype = returns.dtype
     pos = signal_row > 0
@@ -128,7 +135,8 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     prob = BoxQPProblem(q=q, lo=lo, hi=hi, E=E, b=b, l1=l1, center=center)
     res = admm_solve_lowrank(2.0 * alpha, c, 2.0 * s_vec, prob,
                              rho=s.qp_rho,
-                             iters=s.resolved_qp_iters(turnover))
+                             iters=s.resolved_qp_iters(turnover),
+                             warm_start=warm)
     w = res.x
 
     solver_ok = (jnp.all(jnp.isfinite(w))
@@ -152,7 +160,7 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     # handles them silently by design) — not an anomaly, and their discarded
     # solve has no meaningful residual
     resid = jnp.where(t_used >= 2, res.primal_residual, jnp.nan)
-    return w, resid, solver_ok | (t_used < 2)
+    return w, resid, solver_ok | (t_used < 2), res.warm_state
 
 
 def _risk_model_stack(s: SimulationSettings):
@@ -204,43 +212,80 @@ def _risk_model_for_day(stacks, today, s: SimulationSettings):
     return loadings_s[j], fvar_s[j], idio_s[j], hist
 
 
+def _cold_state(n, batch, dtype):
+    """Batch of cold warm-states (zeros; rho NaN -> solver resets to rho0)."""
+    z = jnp.zeros((batch, n), dtype)
+    return ADMMWarmState(z=z, u=jnp.zeros((batch, n), dtype),
+                         rho=jnp.full((batch,), jnp.nan, dtype))
+
+
 def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
     """Per-date minimum-variance weights for the whole panel
-    (``portfolio_simulation.py:183-204``). Dates are independent -> chunked
-    ``lax.map``. Returns (weights [D, N], long_count [D], short_count [D])."""
+    (``portfolio_simulation.py:183-204``). Dates are independent, so chunks
+    of ``mvo_batch`` days solve vmapped in parallel; the chunk loop is a
+    ``lax.scan`` carrying each lane's ADMM exit state so day t warm-starts
+    from day ``t - mvo_batch`` (the closest prior solve in its lane) —
+    disable with ``qp_warm_start=False``. Returns
+    (weights [D, N], long_count [D], short_count [D], resid, ok)."""
+    import jax
+
     d, n = signal.shape
     pos, neg, flat = leg_masks(signal)
     stacks = _risk_model_stack(s) if s.covariance == "risk_model" else None
+    dtype = s.returns.dtype
 
-    def one(today):
+    def one(today, warm):
         rm = (None if stacks is None
               else _risk_model_for_day(stacks, today, s))
-        return _solve_day(signal[today], s.returns, today, jnp.zeros(n, s.returns.dtype),
-                          s, turnover=False, risk_model=rm)
+        return _solve_day(signal[today], s.returns, today, jnp.zeros(n, dtype),
+                          s, turnover=False, risk_model=rm,
+                          warm=warm if s.qp_warm_start else None)
 
-    w, resid, ok = lax.map(one, jnp.arange(d), batch_size=s.mvo_batch)
+    batch = min(s.mvo_batch, d)
+    pad = (-d) % batch
+    days = jnp.concatenate([jnp.arange(d),
+                            jnp.full((pad,), d - 1, jnp.int32)])
+    chunks = days.reshape(-1, batch)
+
+    def chunk_step(warm, todays):
+        w, resid, ok, state = jax.vmap(one)(todays, warm)
+        return state, (w, resid, ok)
+
+    _, (w, resid, ok) = lax.scan(chunk_step, _cold_state(n, batch, dtype),
+                                 chunks)
+    w = w.reshape(-1, n)[:d]
+    resid, ok = resid.reshape(-1)[:d], ok.reshape(-1)[:d]
     return _finalize(w, signal, s, pos, neg, flat, resid, ok)
 
 
 def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
     """Sequential variant: yesterday's (pre-shift) weights feed today's L1
-    turnover term (``portfolio_simulation.py:227-248``) -> ``lax.scan``."""
+    turnover term (``portfolio_simulation.py:227-248``) -> ``lax.scan``.
+    The scan carry also holds the ADMM exit state (z, u, rho), so each day
+    warm-starts from yesterday's solve — the same persistent-solver warm
+    start the reference gets from OSQP (``portfolio_simulation.py:427-437``);
+    disable with ``qp_warm_start=False``."""
     d, n = signal.shape
     pos, neg, flat = leg_masks(signal)
     # the reference's _get_previous_weights reads the last stored row, which
     # is the zero row on flat days — mirror that by carrying the final row.
     zero_day = flat | (_universe_count(signal, s) < 2)
     stacks = _risk_model_stack(s) if s.covariance == "risk_model" else None
+    dtype = s.returns.dtype
 
-    def step(w_prev, today):
+    def step(carry, today):
+        w_prev, warm = carry
         rm = (None if stacks is None
               else _risk_model_for_day(stacks, today, s))
-        w, resid, ok = _solve_day(signal[today], s.returns, today, w_prev, s,
-                                  turnover=True, risk_model=rm)
+        w, resid, ok, state = _solve_day(
+            signal[today], s.returns, today, w_prev, s, turnover=True,
+            risk_model=rm, warm=warm if s.qp_warm_start else None)
         w = jnp.where(zero_day[today], 0.0, w)
-        return w, (w, resid, ok)
+        return (w, state), (w, resid, ok)
 
-    _, (w, resid, ok) = lax.scan(step, jnp.zeros(n, s.returns.dtype),
+    cold = _cold_state(n, 1, dtype)
+    cold = ADMMWarmState(z=cold.z[0], u=cold.u[0], rho=cold.rho[0])
+    _, (w, resid, ok) = lax.scan(step, (jnp.zeros(n, dtype), cold),
                                  jnp.arange(d))
     return _finalize(w, signal, s, pos, neg, flat, resid, ok)
 

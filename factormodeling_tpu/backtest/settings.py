@@ -60,19 +60,31 @@ class SimulationSettings:
     risk_refit_every: int = dataclasses.field(default=21, metadata=dict(static=True))
 
     # ADMM solver knobs (device-side replacement for OSQP/SLSQP).
-    # ``qp_iters=None`` resolves per scheme: 500 for plain mvo, 100 for
-    # mvo_turnover — mirroring the reference's OSQP budgets (max_iter=2000
-    # vs the deliberate max_iter=100 turnover quirk,
-    # portfolio_simulation.py:427-437,486-501) so the default config solves
-    # what the published headline number measures.
+    # ``qp_iters=None`` resolves per scheme (round-5 re-tune, measured on
+    # the exact-optimum QP goldens, docs/architecture.md section 12):
+    # - plain mvo: 200 (the smooth QP reaches the optimum by ~60 with the
+    #   problem-aware rho; 200 keeps >3x margin over the golden panel);
+    # - mvo_turnover: 60 warm-started / 100 cold. The reference's OSQP
+    #   max_iter=100 turnover quirk (portfolio_simulation.py:486-501) is a
+    #   solver-specific budget; the parity criterion is solution quality,
+    #   and 60 warm iterations measure ~2.3x CLOSER to the true optimum
+    #   (mean |w - w_opt| 1.1e-2 vs 2.6e-2) than the round-4 default
+    #   (100 cold iterations at the fixed rho0) while costing 40% less.
     qp_iters: int | None = dataclasses.field(default=None, metadata=dict(static=True))
     qp_rho: float = dataclasses.field(default=2.0, metadata=dict(static=True))
     mvo_batch: int = dataclasses.field(default=32, metadata=dict(static=True))
+    # day-over-day ADMM warm starts (z, u, rho carried through the date scan /
+    # chunk lanes) — the reference's persistent OSQP object does the same
+    # (warm_start=True, portfolio_simulation.py:427-437; the scipy path seeds
+    # x0 = prev_weights, :676-680). Off -> every date solves cold.
+    qp_warm_start: bool = dataclasses.field(default=True, metadata=dict(static=True))
 
     def resolved_qp_iters(self, turnover: bool) -> int:
         if self.qp_iters is not None:
             return self.qp_iters
-        return 100 if turnover else 500
+        if turnover:
+            return 60 if self.qp_warm_start else 100
+        return 200
 
     def __post_init__(self):
         if self.method not in ("equal", "linear", "mvo", "mvo_turnover"):

@@ -13,7 +13,7 @@ import pandas as pd
 import jax.numpy as jnp
 
 from factormodeling_tpu import ops as k
-from factormodeling_tpu.compat._convert import PanelVocab, roundtrip
+from factormodeling_tpu.compat._convert import PanelVocab, jit_kernel, roundtrip
 
 __all__ = [
     "ts_sum", "ts_mean", "ts_std", "ts_zscore", "ts_rank", "ts_diff",
@@ -86,8 +86,9 @@ def cs_rank(series: pd.Series, method: str = "average") -> pd.Series:
         vocab = PanelVocab.from_indexes(series.index)
         values, universe = vocab.densify(series)
         pos = vocab.densify_positions(series.index)
-        out = k.cs_rank(jnp.asarray(values), universe=jnp.asarray(universe),
-                        method="first", tie_order=jnp.asarray(pos))
+        fn = jit_kernel(lambda v, u, p: k.cs_rank(v, universe=u,
+                                                  method="first", tie_order=p))
+        out = fn(jnp.asarray(values), jnp.asarray(universe), jnp.asarray(pos))
         return vocab.align_like(out, series.index, name=series.name)
     return roundtrip(series, lambda v, u: k.cs_rank(v, universe=u, method=method))
 
@@ -167,7 +168,8 @@ def bucket(series: pd.Series, bin_range=(0.2, 1.0, 0.2)) -> pd.Series:
     outside the bins (and NaN) -> NaN, like pd.cut."""
     vocab = PanelVocab.from_indexes(series.index)
     values, universe = vocab.densify(series)
-    ids = np.asarray(k.bucket(jnp.asarray(values), bin_range))
+    ids = np.asarray(jit_kernel(lambda v: k.bucket(v, bin_range))(
+        jnp.asarray(values)))
     aligned = vocab.align_like(ids.astype(float), series.index)
     labels = aligned.map(lambda v: f"group{int(v) + 1}"
                          if np.isfinite(v) and v >= 0 else np.nan)
@@ -189,7 +191,7 @@ def _group_op(series: pd.Series, group: pd.Series, kernel,
     args = (jnp.asarray(values), jnp.asarray(gids), n_groups + 1)
     if need_positions:
         args += (jnp.asarray(vocab.densify_positions(series.index)),)
-    out = kernel(*args)
+    out = jit_kernel(kernel, static_argnums=(2,))(*args)
     out = np.array(out)  # copy: jax buffers are read-only
     out[missing] = np.nan
     return vocab.align_like(out, series.index, name=series.name)
@@ -236,9 +238,9 @@ def ts_regression_fast(y: pd.Series, x: pd.Series, window: int, lag: int = 0,
     vocab = PanelVocab.from_indexes(y.index, x.index)
     yv, yu = vocab.densify(y)
     xv, xu = vocab.densify(x)
-    out = k.ts_regression_fast(jnp.asarray(yv), jnp.asarray(xv), window,
-                               lag=lag, rettype=rettype,
-                               universe=jnp.asarray(yu | xu))
+    fn = jit_kernel(lambda a, b, u: k.ts_regression_fast(
+        a, b, window, lag=lag, rettype=rettype, universe=u))
+    out = fn(jnp.asarray(yv), jnp.asarray(xv), jnp.asarray(yu | xu))
     return vocab.align_like(out, y.index, name=y.name)
 
 
@@ -248,5 +250,6 @@ def cs_regression(y: pd.Series, x: pd.Series, rettype: str = "resid") -> pd.Seri
     vocab = PanelVocab.from_indexes(y.index, x.index)
     yv, _ = vocab.densify(y)
     xv, _ = vocab.densify(x)
-    out = k.cs_regression(jnp.asarray(yv), jnp.asarray(xv), rettype=rettype)
+    fn = jit_kernel(lambda a, b: k.cs_regression(a, b, rettype=rettype))
+    out = fn(jnp.asarray(yv), jnp.asarray(xv))
     return vocab.align_like(out, y.index, name=y.name)

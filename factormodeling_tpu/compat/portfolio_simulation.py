@@ -16,6 +16,7 @@ import dataclasses
 
 import numpy as np
 import pandas as pd
+import jax
 import jax.numpy as jnp
 
 from factormodeling_tpu.analytics import PortfolioAnalyzer as _DenseAnalyzer
@@ -24,15 +25,90 @@ from factormodeling_tpu.backtest import (
     SimulationSettings as _DenseSettings,
     daily_trade_list as _dense_trade_list,
 )
-from factormodeling_tpu.backtest.diagnostics import check_anomalies
+from factormodeling_tpu.backtest.diagnostics import (SolverDiagnostics,
+                                                     check_anomalies)
 from factormodeling_tpu.backtest.pnl import daily_portfolio_returns as _dense_pnl
 from factormodeling_tpu.backtest.pnl import signal_metrics as _dense_signal_metrics
-from factormodeling_tpu.compat._convert import PanelVocab, level_values
+from factormodeling_tpu.compat._convert import (PanelVocab, _IdentityCache,
+                                                level_values)
 
 __all__ = ["SimulationSettings", "Simulation"]
 
 _RESULT_COLUMNS = ("log_return", "long_return", "short_return",
                    "long_turnover", "short_turnover", "turnover")
+
+# device copies of densified panels, keyed on (series, its backing values,
+# vocab) identity: the cell-39 pattern runs several Simulations over the
+# SAME market Series objects, and on a tunneled TPU each redundant
+# host->device transfer costs ~0.2 s (tools/ profiling, round 5) — far more
+# than the sims themselves. The ``_values`` key member is the mutation
+# token (pandas CoW swaps the backing array on any in-place write); the
+# small maxsize bounds pinned HBM (32 x ~5 MB at 1332x1000 f32).
+_DEVICE_PANELS = _IdentityCache(maxsize=32)
+# the run() side product signal*investability, keyed on both operands: the
+# pandas multiply (with index alignment) costs ~0.3 s/sim at 1332x1000
+_MASKED_SIGNALS = _IdentityCache(maxsize=8)
+
+
+def _device_panel(vocab: PanelVocab, series: pd.Series) -> jnp.ndarray:
+    return _DEVICE_PANELS.get(
+        (series, series._values, vocab),
+        lambda: jnp.asarray(vocab.densify(series)[0]))
+
+
+# The dense engine functions are pure jax; calling them UNJITTED dispatches
+# op by op — hundreds of round trips on a tunneled TPU (measured: the whole
+# cell-39 pair ran slower than the reference's pandas loop, round-5
+# profiling). Settings statics are hashable, so one jit per (method, knobs).
+_jit_trade_list = jax.jit(_dense_trade_list)
+_jit_pnl = jax.jit(_dense_pnl)
+
+
+@jax.jit
+def _fused_run_device(sig, uni, s: _DenseSettings, s_full: _DenseSettings):
+    """run()'s whole device pass in ONE dispatch, replicating the two-stage
+    compat composition bit for bit: trade list on the signal's universe,
+    then P&L on the universe-masked weights under the full-grid settings
+    (exactly the arrays the pandas weights round trip would rebuild).
+
+    Everything the host consumes per run lands in ONE packed [13, D] f32
+    array, so the pandas boundary pays a single device fetch instead of
+    ~13 relay round trips (counts, six result columns, five diagnostics)."""
+    w, lc, sc, diag = _dense_trade_list(sig, s)
+    wv = jnp.where(uni, w, jnp.nan)
+    res = _dense_pnl(wv, s_full)
+    f32 = sig.dtype
+    packed = jnp.stack(
+        [getattr(res, c) for c in _RESULT_COLUMNS]
+        + [lc.astype(f32), sc.astype(f32), diag.primal_residual,
+           diag.solver_ok.astype(f32), diag.long_sum, diag.short_sum,
+           diag.active.astype(f32)])
+    return w, res, packed
+
+
+def _finalize_result(frame: pd.DataFrame, res, symbols: pd.Index,
+                     contributor: bool):
+    """Shared result-boundary tail of both run paths: the reference's
+    date-descending frame (``portfolio_simulation.py:783-790``) and, when
+    enabled, the top-10 per-leg contributors (``:792-795``)."""
+    frame = (frame.rename_axis("date").reset_index()
+             .sort_values("date", ascending=False).reset_index(drop=True))
+    if contributor:
+        longs = pd.Series(np.asarray(res.long_pnl_by_name), index=symbols)
+        shorts = pd.Series(np.asarray(res.short_pnl_by_name), index=symbols)
+        return frame, longs.nlargest(10), shorts.nlargest(10)
+    return frame, None, None
+
+
+def _unpack(packed: np.ndarray):
+    """(result columns dict, lc, sc, SolverDiagnostics) from the packed
+    [13, D] host array."""
+    cols = {c: packed[i] for i, c in enumerate(_RESULT_COLUMNS)}
+    lc, sc = packed[6], packed[7]
+    diag = SolverDiagnostics(
+        primal_residual=packed[8], solver_ok=packed[9] > 0.5,
+        long_sum=packed[10], short_sum=packed[11], active=packed[12] > 0.5)
+    return cols, lc, sc, diag
 
 
 @dataclasses.dataclass
@@ -92,12 +168,10 @@ class Simulation:
     def _dense_settings(self, signal_universe: np.ndarray,
                         vocab: PanelVocab | None = None) -> _DenseSettings:
         vocab = vocab if vocab is not None else self._vocab
-        rets, _ = vocab.densify(self.returns)
-        cap, _ = vocab.densify(self.cap_flag)
-        inv, _ = vocab.densify(self.investability_flag)
         return _DenseSettings(
-            returns=jnp.asarray(rets), cap_flag=jnp.asarray(cap),
-            investability_flag=jnp.asarray(inv),
+            returns=_device_panel(vocab, self.returns),
+            cap_flag=_device_panel(vocab, self.cap_flag),
+            investability_flag=_device_panel(vocab, self.investability_flag),
             universe=jnp.asarray(signal_universe),
             method=self.method, transaction_cost=self.transaction_cost,
             max_weight=self.max_weight, pct=self.pct,
@@ -124,14 +198,30 @@ class Simulation:
         ``output_returns`` is set."""
         if self.factors_df is not None:
             self.factors_df[self.name] = self.custom_feature
-        self.custom_feature = self.custom_feature * self.investability_flag
-        weights, counts = self._daily_trade_list()
-        result, top_longs, top_shorts = self._daily_portfolio_returns(weights)
+        raw, inv = self.custom_feature, self.investability_flag
+        self.custom_feature = _MASKED_SIGNALS.get(
+            (raw, raw._values, inv, inv._values), lambda: raw * inv)
+        sig, uni = self._vocab.densify(self.custom_feature)
+        weights = None
+        if bool(uni.any(axis=1).all()):
+            # fast path (every vocab date carries >=1 universe cell, so the
+            # two-stage pandas weights round trip is the identity): one
+            # fused device dispatch, pandas only at the result boundary
+            counts, result, top_longs, top_shorts, w_dense = \
+                self._run_fused(sig, uni)
+        else:
+            weights, counts = self._daily_trade_list()
+            result, top_longs, top_shorts = \
+                self._daily_portfolio_returns(weights)
+            w_dense = None
         analyzer = _DenseAnalyzer(
             {c: result[c].to_numpy() for c in _RESULT_COLUMNS},
             result["date"].to_numpy())
 
         if self.output_summary:
+            if weights is None:
+                weights = self._vocab.to_series(np.asarray(w_dense), uni,
+                                                name="weight")
             metrics = self._calculate_metrics(weights, counts)
             summary_df = (pd.DataFrame.from_dict(analyzer.summary(),
                                                  orient="index",
@@ -151,6 +241,31 @@ class Simulation:
             return result
         return None
 
+    def _run_fused(self, sig: np.ndarray, uni: np.ndarray):
+        """One-dispatch run() body (see ``_fused_run_device``). Valid only
+        when every vocab date has a universe cell — then the weights' date
+        set equals the vocab's and the pandas round trip between the two
+        stages is the identity (``_daily_portfolio_returns`` docstring has
+        the edge this guard excludes)."""
+        s = self._dense_settings(uni)
+        s_full = dataclasses.replace(
+            s, universe=jnp.ones(self._vocab.shape, bool))
+        sig_dev = _DEVICE_PANELS.get(
+            (self.custom_feature, self.custom_feature._values, self._vocab),
+            lambda: jnp.asarray(sig))
+        uni_dev = jnp.asarray(uni)
+        w, res, packed = _fused_run_device(sig_dev, uni_dev, s, s_full)
+        cols, lc, sc, diag = _unpack(np.asarray(packed))
+        check_anomalies(diag, name=self.name)
+        counts = pd.DataFrame(
+            {"long_count": lc.astype(int), "short_count": sc.astype(int)},
+            index=pd.Index(self._vocab.dates, name="date"))
+        result = pd.DataFrame(cols,
+                              index=pd.Index(self._vocab.dates, name="date"))
+        result, top_longs, top_shorts = _finalize_result(
+            result, res, self._vocab.symbols, self.contributor)
+        return counts, result, top_longs, top_shorts, w
+
     def _daily_trade_list(self):
         """(shifted weights Series, counts DataFrame)
         (``portfolio_simulation.py:96-154``). Weights cover the signal's own
@@ -161,7 +276,7 @@ class Simulation:
         trade the raw signal."""
         sig, uni = self._vocab.densify(self.custom_feature)
         s = self._dense_settings(uni)
-        w, lc, sc, diag = _dense_trade_list(jnp.asarray(sig), s)
+        w, lc, sc, diag = _jit_trade_list(jnp.asarray(sig), s)
         # replay the reference's runtime warnings (portfolio_simulation.py:
         # 448-449 leg sums, :452-459 solver fallback) after the device pass
         check_anomalies(diag, name=self.name)
@@ -194,7 +309,7 @@ class Simulation:
         vocab = PanelVocab(w_dates, self._vocab.symbols)
         wv, _ = vocab.densify(weights)
         s = self._dense_settings(np.ones(vocab.shape, dtype=bool), vocab)
-        res = _dense_pnl(jnp.asarray(wv), s)
+        res = _jit_pnl(jnp.asarray(wv), s)
         result = pd.DataFrame({c: np.asarray(getattr(res, c))
                                for c in _RESULT_COLUMNS},
                               index=pd.Index(vocab.dates, name="date"))
@@ -204,16 +319,7 @@ class Simulation:
             result = result.reindex(all_dates)
             ret_cols = ["log_return", "long_return", "short_return"]
             result[ret_cols] = result[ret_cols].fillna(0.0)
-        result = (result.rename_axis("date").reset_index()
-                  .sort_values("date", ascending=False)
-                  .reset_index(drop=True))
-        if self.contributor:
-            longs = pd.Series(np.asarray(res.long_pnl_by_name),
-                              index=vocab.symbols)
-            shorts = pd.Series(np.asarray(res.short_pnl_by_name),
-                               index=vocab.symbols)
-            return result, longs.nlargest(10), shorts.nlargest(10)
-        return result, None, None
+        return _finalize_result(result, res, vocab.symbols, self.contributor)
 
     def _calculate_metrics(self, weights: pd.Series,
                            counts: pd.DataFrame) -> pd.DataFrame:

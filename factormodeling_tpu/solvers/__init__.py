@@ -8,6 +8,7 @@ problems never materialize an N x N matrix.
 """
 
 from factormodeling_tpu.solvers.admm_qp import (  # noqa: F401
+    ADMMWarmState,
     BoxQPProblem,
     admm_solve_dense,
     admm_solve_lowrank,

@@ -48,7 +48,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["BoxQPProblem", "admm_solve_dense", "admm_solve_lowrank"]
+__all__ = ["ADMMWarmState", "BoxQPProblem", "admm_solve_dense",
+           "admm_solve_lowrank"]
 
 
 @jax.tree_util.register_dataclass
@@ -69,6 +70,32 @@ class ADMMResult(NamedTuple):
     x: jnp.ndarray          # equality-exact iterate
     z: jnp.ndarray          # box/L1-exact iterate
     primal_residual: jnp.ndarray  # max |x - z|
+    u: jnp.ndarray          # scaled dual at exit (warm-start carry)
+    rho: jnp.ndarray        # adapted penalty at exit (warm-start carry)
+
+    @property
+    def warm_state(self) -> "ADMMWarmState":
+        """The (z, u, rho) triple to feed the next related solve."""
+        return ADMMWarmState(z=self.z, u=self.u, rho=self.rho)
+
+
+class ADMMWarmState(NamedTuple):
+    """Warm-start state from a previous, related solve — the day-over-day
+    carry the reference gets from OSQP's ``warm_start=True`` (its solver
+    object persists x/y across dates, ``portfolio_simulation.py:427-437``;
+    the scipy path seeds ``x0 = prev_weights``, ``:676-680``). ``z`` is
+    clipped into the new problem's box before use; ``u`` is the scaled
+    dual in the solver's internal objective scaling (day-over-day scale
+    drift just perturbs the start, never correctness). ``rho`` records the
+    penalty ``u`` is scaled by: the next solve starts from ITS OWN
+    problem-aware rho and re-centers the dual by ``u * rho_prev/rho_start``
+    — without that rescale a rho mismatch mis-scales the dual by orders of
+    magnitude, measured to make warm starts WORSE than cold
+    (docs/architecture.md section 12)."""
+
+    z: jnp.ndarray
+    u: jnp.ndarray
+    rho: jnp.ndarray
 
 
 def _soft(a, k):
@@ -95,7 +122,7 @@ def _unroll_factor() -> int:
 
 
 def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
-                     relax):
+                     relax, warm=None):
     """Shared ADMM loop with residual-balanced adaptive rho.
 
     ``make_solver(rho)`` returns a function applying (P + rho I)^{-1}; it is
@@ -158,41 +185,77 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
         u = u * (rho / rho_new)
         return x, z, u, rho_new
 
-    z0 = jnp.clip(jnp.zeros(n, dtype), prob.lo, prob.hi)
-    u0 = jnp.zeros(n, dtype)
-    rho = jnp.asarray(rho0, dtype)
+    # Problem-aware initial penalty: the z-step soft-threshold moves by
+    # l1/rho per iteration, and the useful threshold scale is the typical
+    # weight magnitude ~1/n_free — so rho far from l1 * n_free wastes the
+    # first several residual-balancing segments climbing (<= x5 per
+    # segment). Measured on the exact-optimum QP goldens and a 200-asset
+    # self-oracle (docs/architecture.md section 12): the best fixed rho is
+    # ~100 at 20 free names and ~1000 at 200 for l1/scale ~ 1e2 — i.e.
+    # rho* ~ l1 * n_free / 20 — and starting there drops the default-budget
+    # mean |w - w_opt| 0.026 -> 0.001 (20 names) / 0.0065 -> 0.0014 (200).
+    n_free = jnp.maximum((prob.hi > prob.lo).sum(), 1).astype(dtype)
+    rho_start = jnp.clip(jnp.maximum(jnp.asarray(rho0, dtype),
+                                     jnp.max(l1) * n_free / 20.0),
+                         *_RHO_BOUNDS)
+    if warm is None:
+        z0 = jnp.clip(jnp.zeros(n, dtype), prob.lo, prob.hi)
+        u0 = jnp.zeros(n, dtype)
+        rho = rho_start
+    else:
+        # Yesterday's iterates, snapped into today's box (pinned names and
+        # leg membership move day over day). u is the SCALED dual y/rho:
+        # re-center it on today's starting rho using the carried exit rho,
+        # else a rho mismatch mis-scales the dual by orders of magnitude.
+        # Non-finite carries (a failed prior solve) reset cold so one bad
+        # day cannot poison the rest of the scan.
+        rho = rho_start
+        rho_prev = jnp.nan_to_num(warm.rho, nan=0.0)
+        z0 = jnp.clip(jnp.nan_to_num(warm.z), prob.lo, prob.hi)
+        u0 = jnp.nan_to_num(warm.u) * (rho_prev / rho)
     carry = (z0, z0, u0, rho)
     unroll = _unroll_factor()
     iters = int(iters)
-    if unroll > 1:
-        # TPU: Python-level segment schedule -> static bounds -> unrolled
-        # bodies (each segment traces separately; segment counts are small).
-        # iters=0 still runs one zero-length segment (its rho balancing sees
-        # the untouched iterates), exactly like the rolled path below.
-        schedule = ([min(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
-                     for k in range(-(-iters // _ADAPT_EVERY))] or [0])
-        for seg_len in schedule:
-            carry = segment(carry, seg_len, max(min(seg_len, unroll), 1))
-    else:
-        # rolled path: one traced segment body inside a fori_loop (cheapest
-        # to compile; the last segment runs the remainder so totals match)
-        def seg_k(k, c):
-            seg_len = jnp.minimum(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
-            return segment(c, seg_len, 1)
+    # The iteration is a chain of small matvecs whose errors feed back
+    # through the dual; TPU's default-bf16 dot precision floors the primal
+    # residual ~20x above the f32 level (measured 7.1e-2 vs 3.5e-3 p99 at
+    # 256x200 — enough to break the leg-sum invariant the engine promises).
+    # Force full-f32 dots for everything traced in the loop; the matvecs
+    # are tiny and latency-bound, so the extra MXU passes are free.
+    with jax.default_matmul_precision("highest"):
+        if unroll > 1:
+            # TPU: Python-level segment schedule -> static bounds -> unrolled
+            # bodies (each segment traces separately; segment counts are
+            # small). iters=0 still runs one zero-length segment (its rho
+            # balancing sees the untouched iterates), like the rolled path.
+            schedule = ([min(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
+                         for k in range(-(-iters // _ADAPT_EVERY))] or [0])
+            for seg_len in schedule:
+                carry = segment(carry, seg_len, max(min(seg_len, unroll), 1))
+        else:
+            # rolled path: one traced segment body inside a fori_loop
+            # (cheapest to compile; the last segment runs the remainder)
+            def seg_k(k, c):
+                seg_len = jnp.minimum(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
+                return segment(c, seg_len, 1)
 
-        n_seg = max(-(-iters // _ADAPT_EVERY), 1)    # ceil: total == iters
-        carry = lax.fori_loop(0, n_seg, seg_k, carry)
-    x, z, u, rho = carry
-    x = x_step(factor(rho), z, u, rho)  # final equality-exact polish
-    return ADMMResult(x=x, z=z, primal_residual=jnp.max(jnp.abs(x - z)))
+            n_seg = max(-(-iters // _ADAPT_EVERY), 1)  # ceil: total == iters
+            carry = lax.fori_loop(0, n_seg, seg_k, carry)
+        x, z, u, rho = carry
+        x = x_step(factor(rho), z, u, rho)  # final equality-exact polish
+    return ADMMResult(x=x, z=z, primal_residual=jnp.max(jnp.abs(x - z)),
+                      u=u, rho=rho)
 
 
 def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
-                     iters: int = 500, relax: float = 1.6) -> ADMMResult:
+                     iters: int = 500, relax: float = 1.6,
+                     warm_start: ADMMWarmState | None = None) -> ADMMResult:
     """Dense-P path (small n: factor-selection MVO). P must be symmetric PSD.
 
     ``rho`` is the initial penalty; residual balancing adapts it every
-    ``_ADAPT_EVERY`` iterations. Exactly ``iters`` iterations run."""
+    ``_ADAPT_EVERY`` iterations. Exactly ``iters`` iterations run.
+    ``warm_start`` seeds (z, u, rho) from a previous related solve
+    (``ADMMResult.warm_state``)."""
     n = P.shape[-1]
     scale = jnp.maximum(jnp.trace(P) / n, 1e-12)
     Ps = P / scale
@@ -204,12 +267,14 @@ def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
         chol = jax.scipy.linalg.cho_factor(Ps + rho * eye)
         return lambda r: jax.scipy.linalg.cho_solve(chol, r)
 
-    return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax)
+    return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax,
+                            warm=warm_start)
 
 
 def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
                        prob: BoxQPProblem, *, rho: float = 2.0,
-                       iters: int = 500, relax: float = 1.6) -> ADMMResult:
+                       iters: int = 500, relax: float = 1.6,
+                       warm_start: ADMMWarmState | None = None) -> ADMMResult:
     """Low-rank path: P = diag(alpha) + V' diag(s) V with V: [T, n], T << n.
 
     ``alpha`` is a scalar (the backtest's shrinkage/jitter identity,
@@ -221,7 +286,9 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
     per iteration, no N x N matrix ever formed. ``rho`` is the initial
     penalty; residual balancing adapts it every ``_ADAPT_EVERY`` iterations
     (each update re-runs the T x T factorization only). Exactly ``iters``
-    iterations run.
+    iterations run. ``warm_start`` seeds (z, u, rho) from a previous related
+    solve (``ADMMResult.warm_state``) — the day-over-day carry in
+    ``backtest/mvo.py``'s schemes.
     """
     t, n = V.shape
     alpha = jnp.asarray(alpha)
@@ -256,4 +323,5 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
 
         return solve_m
 
-    return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax)
+    return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax,
+                            warm=warm_start)

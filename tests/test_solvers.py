@@ -188,3 +188,40 @@ def test_spd_solve_matches_numpy_and_propagates_nan(rng):
     sing = np.zeros((1, 3, 3))
     out = np.asarray(spd_solve(jnp.array(sing), jnp.ones((1, 3))))
     assert np.isnan(out).all()
+
+
+def test_warm_start_accelerates_l1_convergence(rng):
+    """Day-over-day warm start (``ADMMResult.warm_state`` -> ``warm_start``):
+    on a perturbed L1 (turnover-style) problem, a small warm budget must land
+    at least as close to the exact optimum as the same budget cold, and
+    dramatically closer than cold at the L1-flat default. Mirrors the
+    reference's persistent OSQP warm start (portfolio_simulation.py:427-437)."""
+    n, t = 30, 20
+    R = rng.normal(0, 0.02, size=(t, n))
+    C = R - R.mean(0)
+    alpha = 0.1 * np.diag(np.cov(R, rowvar=False)).mean() + 1e-6
+    s_row = 0.9 / (t - 1)
+    sig = rng.normal(size=n)
+    pos = sig > 0
+    lo = np.where(pos, 0.0, -0.2)
+    hi = np.where(pos, 0.2, 0.0)
+    E = np.stack([np.where(pos, 1.0, 0.0), np.where(~pos, 1.0, 0.0)])
+    b = np.array([1.0, -1.0])
+    center = rng.dirichlet(np.ones(pos.sum())) @ np.eye(n)[pos]  # prior day
+
+    def solve(q_shift, iters, warm=None):
+        prob = BoxQPProblem(jnp.array(np.full(n, q_shift)), jnp.array(lo),
+                            jnp.array(hi), jnp.array(E), jnp.array(b),
+                            jnp.array(0.1), jnp.array(center))
+        return admm_solve_lowrank(jnp.array(2 * alpha), jnp.array(C),
+                                  jnp.full(t, 2 * s_row), prob, iters=iters,
+                                  warm_start=warm)
+
+    res_prev = solve(0.0, 3000)               # yesterday, solved tight
+    opt = np.asarray(solve(1e-4, 3000).x)     # today's exact optimum
+    cold = np.asarray(solve(1e-4, 60).x)
+    warm = np.asarray(solve(1e-4, 60, warm=res_prev.warm_state).x)
+    gap_cold = np.abs(cold - opt).mean()
+    gap_warm = np.abs(warm - opt).mean()
+    assert gap_warm <= gap_cold + 1e-6, (gap_warm, gap_cold)
+    assert gap_warm < 1e-3, gap_warm

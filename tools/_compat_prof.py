@@ -1,0 +1,49 @@
+import time
+import numpy as np, pandas as pd
+import jax
+from factormodeling_tpu.compat import operations as compat_ops
+from factormodeling_tpu.compat.portfolio_simulation import Simulation, SimulationSettings
+
+d, n = 1332, 1000
+rng = np.random.default_rng(11)
+dates = pd.date_range("2018-01-02", periods=d, freq="B")
+symbols = pd.Index([f"S{i:04d}" for i in range(n)], name="symbol")
+idx = pd.MultiIndex.from_product([dates, symbols], names=["date", "symbol"])
+keep = rng.uniform(size=len(idx)) > 0.03
+idx = idx[keep]
+m = len(idx)
+returns = pd.Series(rng.normal(scale=0.02, size=m), index=idx)
+cap = pd.Series(rng.integers(1, 4, size=m).astype(float), index=idx)
+inv = pd.Series(np.ones(m), index=idx)
+raw_signal = pd.Series(rng.normal(size=m), index=idx)
+
+def stage(name, f, reps=2):
+    out = f()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f()
+    print(f"{name:30s} {(time.perf_counter()-t0)/reps:8.3f}s")
+    return out
+
+signal = stage("ts_decay(150) roundtrip", lambda: compat_ops.ts_decay(raw_signal, 150))
+
+def one_sim(method):
+    st = SimulationSettings(returns=returns, cap_flag=cap, investability_flag=inv,
+        factors_df=None, method=method, plot=False, output_returns=True,
+        pct=0.1, max_weight=0.03)
+    return Simulation(f"s_{method}", signal, st).run()
+
+stage("sim equal", lambda: one_sim("equal"))
+stage("sim linear", lambda: one_sim("linear"))
+
+# micro: vocab + densify + align
+from factormodeling_tpu.compat._convert import PanelVocab
+import jax.numpy as jnp
+stage("vocab build (uncached)", lambda: PanelVocab._build((idx,)))
+vocab = PanelVocab.from_indexes(idx)
+stage("codes (uncached)", lambda: vocab._codes(idx))
+stage("densify", lambda: vocab.densify(returns))
+vals, uni = vocab.densify(returns)
+stage("to-device", lambda: jax.block_until_ready(jnp.asarray(vals)))
+stage("align_like", lambda: vocab.align_like(vals, idx))
+stage("to_series", lambda: vocab.to_series(vals, uni))
