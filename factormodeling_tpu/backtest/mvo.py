@@ -160,7 +160,16 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     # handles them silently by design) — not an anomaly, and their discarded
     # solve has no meaningful residual
     resid = jnp.where(t_used >= 2, res.primal_residual, jnp.nan)
-    return w, resid, solver_ok | (t_used < 2), res.warm_state
+    # a REJECTED solve's iterates describe a problem whose solution was
+    # discarded (the traded w is the fallback) — carrying them would seed
+    # tomorrow's reduced warm budget with an inconsistent start; reset that
+    # lane cold (rho=NaN is the solver's cold sentinel)
+    state = res.warm_state
+    state = state._replace(
+        z=jnp.where(solver_ok, state.z, 0.0),
+        u=jnp.where(solver_ok, state.u, 0.0),
+        rho=jnp.where(solver_ok, state.rho, jnp.nan))
+    return w, resid, solver_ok | (t_used < 2), state
 
 
 def _risk_model_stack(s: SimulationSettings):

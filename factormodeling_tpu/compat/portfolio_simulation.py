@@ -165,13 +165,21 @@ class Simulation:
 
     # ------------------------------------------------------------ internals
 
-    def _dense_settings(self, signal_universe: np.ndarray,
-                        vocab: PanelVocab | None = None) -> _DenseSettings:
+    def _dense_settings(self, signal_universe, vocab: PanelVocab | None = None,
+                        cache: bool = True) -> _DenseSettings:
+        """``cache=False`` for ad-hoc vocabs (the slow path's per-call
+        weights-dates grid): their panels can never be re-served, and
+        inserting them would FIFO-evict the live market panels."""
         vocab = vocab if vocab is not None else self._vocab
+        if cache:
+            put = lambda series: _device_panel(vocab, series)  # noqa: E731
+        else:
+            put = lambda series: jnp.asarray(  # noqa: E731
+                vocab.densify(series)[0])
         return _DenseSettings(
-            returns=_device_panel(vocab, self.returns),
-            cap_flag=_device_panel(vocab, self.cap_flag),
-            investability_flag=_device_panel(vocab, self.investability_flag),
+            returns=put(self.returns),
+            cap_flag=put(self.cap_flag),
+            investability_flag=put(self.investability_flag),
             universe=jnp.asarray(signal_universe),
             method=self.method, transaction_cost=self.transaction_cost,
             max_weight=self.max_weight, pct=self.pct,
@@ -247,14 +255,15 @@ class Simulation:
         set equals the vocab's and the pandas round trip between the two
         stages is the identity (``_daily_portfolio_returns`` docstring has
         the edge this guard excludes)."""
+        vocab = self._vocab
         s = self._dense_settings(uni)
-        s_full = dataclasses.replace(
-            s, universe=jnp.ones(self._vocab.shape, bool))
+        ones = _DEVICE_PANELS.get(      # per-vocab, reused every run
+            (vocab,), lambda: jnp.ones(vocab.shape, bool))
+        s_full = dataclasses.replace(s, universe=ones)
         sig_dev = _DEVICE_PANELS.get(
-            (self.custom_feature, self.custom_feature._values, self._vocab),
+            (self.custom_feature, self.custom_feature._values, vocab),
             lambda: jnp.asarray(sig))
-        uni_dev = jnp.asarray(uni)
-        w, res, packed = _fused_run_device(sig_dev, uni_dev, s, s_full)
+        w, res, packed = _fused_run_device(sig_dev, s.universe, s, s_full)
         cols, lc, sc, diag = _unpack(np.asarray(packed))
         check_anomalies(diag, name=self.name)
         counts = pd.DataFrame(
@@ -308,7 +317,8 @@ class Simulation:
             level_values(weights.index, "date", 0).unique()).sort_values()
         vocab = PanelVocab(w_dates, self._vocab.symbols)
         wv, _ = vocab.densify(weights)
-        s = self._dense_settings(np.ones(vocab.shape, dtype=bool), vocab)
+        s = self._dense_settings(np.ones(vocab.shape, dtype=bool), vocab,
+                                 cache=False)
         res = _jit_pnl(jnp.asarray(wv), s)
         result = pd.DataFrame({c: np.asarray(getattr(res, c))
                                for c in _RESULT_COLUMNS},

@@ -1,0 +1,140 @@
+"""2-process ``jax.distributed`` correctness check (worker + launcher).
+
+The reference has no distributed backend at all (SURVEY.md section 2.8);
+this repo's multi-host story is ``parallel/cluster.py`` — and a layout test
+alone does not prove the bring-up path works. This module is the executable
+proof: the launcher spawns two REAL processes on localhost, each with 4
+virtual CPU devices; the workers rendezvous through
+``initialize_cluster(coordinator_address=...)`` (the NCCL/MPI-rendezvous
+analog), build the hybrid mesh over the 8 global devices, run the sharded
+research step on identical inputs, and assert the globally-sharded result
+equals each process's own unsharded computation to 1e-10 (x64).
+
+Used by ``tests/test_distributed.py`` (CI) and ``__graft_entry__.
+dryrun_multichip`` (the driver's multi-chip validation).
+
+Worker entry: ``python -m factormodeling_tpu.parallel._dist_check <rank>
+<port>`` — prints ``DIST_OK <rank>`` on success.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+_NPROC = 2
+_LOCAL_DEVICES = 4
+
+
+def worker(rank: int, port: int) -> None:
+    # must win the platform race against any sitecustomize that points JAX
+    # at a real accelerator: config.update before the first backend touch
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={_LOCAL_DEVICES}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from factormodeling_tpu.parallel import (initialize_cluster,
+                                             make_hybrid_mesh,
+                                             make_sharded_research_step)
+    from factormodeling_tpu.parallel.pipeline import build_research_step
+
+    initialize_cluster(coordinator_address=f"127.0.0.1:{port}",
+                       num_processes=_NPROC, process_id=rank)
+    assert jax.process_count() == _NPROC, jax.process_count()
+    assert len(jax.local_devices()) == _LOCAL_DEVICES
+    assert jax.device_count() == _NPROC * _LOCAL_DEVICES
+
+    # identical inputs in both processes (same seed)
+    rng = np.random.default_rng(7)
+    f, d, n, window = 8, 32, 16, 6
+    names = ["a_eq", "a_flx", "b_long", "b_short",
+             "c_eq", "c_flx", "d_long", "d_short"]
+    factors = rng.normal(size=(f, d, n))
+    factors[rng.uniform(size=factors.shape) < 0.05] = np.nan
+    returns = rng.normal(scale=0.02, size=(d, n))
+    factor_ret = rng.normal(scale=0.01, size=(d, f))
+    cap = rng.integers(1, 4, size=(d, n)).astype(float)
+    invest = np.ones((d, n))
+    universe = np.ones((d, n), dtype=bool)
+    raw = (factors, returns, factor_ret, cap, invest, universe)
+
+    cfg = dict(names=names, window=window,
+               sim_kwargs=dict(method="equal", pct=0.3))
+    mesh = make_hybrid_mesh(("factor", "date"))
+    assert mesh.devices.size == _NPROC * _LOCAL_DEVICES
+    step, shard_inputs = make_sharded_research_step(mesh, **cfg)
+    sharded = step(*shard_inputs(*raw))
+
+    local = jax.jit(build_research_step(**cfg))(
+        *[jnp.asarray(a) for a in raw])
+
+    from jax.experimental import multihost_utils
+
+    for name, got_g, exp in (
+            ("selection", sharded.selection, local.selection),
+            ("signal", sharded.signal, local.signal),
+            ("log_return", sharded.sim.result.log_return,
+             local.sim.result.log_return)):
+        got = multihost_utils.process_allgather(got_g, tiled=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   atol=1e-10, equal_nan=True, err_msg=name)
+    assert abs(float(sharded.summary.sharpe)
+               - float(local.summary.sharpe)) < 1e-8
+    print(f"DIST_OK {rank}", flush=True)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(timeout: float = 420.0) -> None:
+    """Spawn the 2 worker processes and raise unless both print DIST_OK."""
+    port = free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "factormodeling_tpu.parallel._dist_check",
+         str(rank), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for rank in range(_NPROC)]
+    # poll both rather than communicate() sequentially: if one worker dies
+    # pre-rendezvous the other hangs, and the diagnostic that matters is the
+    # DEAD worker's output — kill the survivor and report everything
+    import time
+
+    deadline = time.monotonic() + timeout
+    timed_out = False
+    while any(p.poll() is None for p in procs):
+        if time.monotonic() > deadline or any(
+                p.returncode not in (None, 0) for p in procs):
+            timed_out = time.monotonic() > deadline
+            break
+        time.sleep(0.2)
+    outs = []
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        try:
+            out, _ = p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out = "<no output: worker unresponsive after kill>"
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"DIST_OK {rank}" not in out:
+            raise RuntimeError(
+                f"distributed worker {rank} failed (rc={p.returncode}, "
+                f"timeout={timed_out}):\n" + out[-4000:])
+
+
+if __name__ == "__main__":
+    worker(int(sys.argv[1]), int(sys.argv[2]))

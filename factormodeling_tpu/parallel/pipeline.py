@@ -151,6 +151,19 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
 
     d_size = mesh.shape[date_axis]
 
+    def _put(a, s):
+        if jax.process_count() > 1:
+            # multi-controller: each process feeds its addressable shards
+            # from its own (identical — the caller's contract) host copy.
+            # Plain device_put would work too but asserts cross-process
+            # VALUE equality with ==, which any NaN panel fails (NaN != NaN)
+            import numpy as np
+
+            host = np.asarray(a)
+            return jax.make_array_from_callback(host.shape, s,
+                                                lambda idx: host[idx])
+        return jax.device_put(a, s)
+
     def shard_inputs(factors, returns, factor_ret, cap_flag, investability,
                      universe):
         if returns.shape[0] % d_size:
@@ -160,6 +173,6 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
                 f"rows, universe=False) or pick a mesh whose date axis "
                 f"divides D")
         args = (factors, returns, factor_ret, cap_flag, investability, universe)
-        return tuple(jax.device_put(a, s) for a, s in zip(args, in_shardings))
+        return tuple(_put(a, s) for a, s in zip(args, in_shardings))
 
     return jitted, shard_inputs
