@@ -65,6 +65,9 @@ def _window_factors(returns: jnp.ndarray, today: jnp.ndarray, lookback: int):
     ``lookback`` of them (``portfolio_simulation.py:315-359``).
     """
     d, n = returns.shape
+    # a lookback longer than the panel is legal (the reference's pandas
+    # window just comes up short); the static slice size must not exceed D
+    lookback = min(lookback, d)
     start = jnp.maximum(today - lookback, 0)
     t_used = today - start  # number of usable rows
     rows = lax.dynamic_slice(jnp.nan_to_num(returns), (start, 0), (lookback, n))
@@ -89,7 +92,7 @@ def _shrunk_terms(c: jnp.ndarray, t_used, lam: float, dtype):
 
 def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
                s: SimulationSettings, turnover: bool, risk_model=None,
-               warm: ADMMWarmState | None = None):
+               warm: ADMMWarmState | None = None, force_fallback=None):
     """One date's MVO solve with the full fallback ladder.
 
     ``risk_model``: optional ``(loadings [N, k], factor_var [k], idio [N],
@@ -103,6 +106,17 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     day-over-day carry mirroring the reference's persistent OSQP warm start
     (``portfolio_simulation.py:427-437``).
 
+    ``force_fallback``: optional bool scalar marking a day the REFERENCE's
+    solver rejects before solving, so the ladder must take its equal-x0
+    branch regardless of our solver's health. The turnover scheme passes
+    the reference's NaN-signal failure here: ``_solve_mvo_turnover_cvxpy``
+    puts the day's raw signal into the objective even at return_weight=0
+    (``portfolio_simulation.py:498-501``), so ANY NaN among the day's
+    present signal values makes cvxpy reject the problem data and the
+    reference falls back (``:575-583``) — found by the round-5 QP
+    differential fuzz. Plain mvo's objective is variance-only (``:399``),
+    so it has no such trigger.
+
     Returns ``(w [N], primal_residual [], solver_ok [], warm_state)`` — the
     residual and acceptance flag feed :class:`~factormodeling_tpu.backtest.
     diagnostics.SolverDiagnostics`; ``warm_state`` is the exit iterate for
@@ -115,7 +129,8 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     if risk_model is None:
         c, t_used = _window_factors(returns, today, s.lookback_period)
         alpha, s_row = _shrunk_terms(c, t_used, s.shrinkage_intensity, dtype)
-        s_vec = jnp.where(jnp.arange(s.lookback_period) < t_used, s_row, 0.0)
+        # row-scale vector sized to the CLAMPED window (c's actual T)
+        s_vec = jnp.where(jnp.arange(c.shape[0]) < t_used, s_row, 0.0)
     else:
         loadings, factor_var, idio, t_used = risk_model
         alpha, c, s_vec = idio, loadings.T, factor_var  # V = B': [k, N]
@@ -141,6 +156,8 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
 
     solver_ok = (jnp.all(jnp.isfinite(w))
                  & legs_feasible(signal_row, s.max_weight) & (t_used >= 2))
+    if force_fallback is not None:
+        solver_ok = solver_ok & ~force_fallback
     w = jnp.where(solver_ok, w, _x0_legs(signal_row))
 
     if turnover:
@@ -281,6 +298,12 @@ def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
     zero_day = flat | (_universe_count(signal, s) < 2)
     stacks = _risk_model_stack(s) if s.covariance == "risk_model" else None
     dtype = s.returns.dtype
+    # the reference's NaN-signal solver rejection (see _solve_day docstring):
+    # a present (universe) cell with a NaN signal value fails its cvxpy data
+    # validation on the turnover objective -> equal-x0 fallback day
+    present = (s.universe if s.universe is not None
+               else jnp.ones(signal.shape, bool))
+    nan_sig_day = (jnp.isnan(signal) & present).any(-1)
 
     def step(carry, today):
         w_prev, warm = carry
@@ -288,7 +311,8 @@ def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
               else _risk_model_for_day(stacks, today, s))
         w, resid, ok, state = _solve_day(
             signal[today], s.returns, today, w_prev, s, turnover=True,
-            risk_model=rm, warm=warm if s.qp_warm_start else None)
+            risk_model=rm, warm=warm if s.qp_warm_start else None,
+            force_fallback=nan_sig_day[today])
         w = jnp.where(zero_day[today], 0.0, w)
         return (w, state), (w, resid, ok)
 

@@ -238,9 +238,15 @@ def ts_regression_fast(y: pd.Series, x: pd.Series, window: int, lag: int = 0,
     vocab = PanelVocab.from_indexes(y.index, x.index)
     yv, yu = vocab.densify(y)
     xv, xu = vocab.densify(x)
+    # the reference rolls over the JOINT-dropna'd rows (operations.py:200):
+    # a present row whose y OR x value is NaN is compacted out of the
+    # window sequence, exactly like an absent row — so the kernel's
+    # universe is the joint-validity mask, not mere presence (a deeper-
+    # soak fuzz distinction, round 5)
+    valid = yu & xu & ~np.isnan(yv) & ~np.isnan(xv)
     fn = jit_kernel(lambda a, b, u: k.ts_regression_fast(
         a, b, window, lag=lag, rettype=rettype, universe=u))
-    out = fn(jnp.asarray(yv), jnp.asarray(xv), jnp.asarray(yu | xu))
+    out = fn(jnp.asarray(yv), jnp.asarray(xv), jnp.asarray(valid))
     return vocab.align_like(out, y.index, name=y.name)
 
 

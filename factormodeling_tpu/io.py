@@ -42,6 +42,8 @@ import pandas as pd
 from factormodeling_tpu.panel import FactorPanel, Panel, _densify_long
 
 __all__ = [
+    "disk_chunk_source",
+    "save_factor_stack_chunks",
     "ArtifactStore",
     "FactorReturns",
     "MarketData",
@@ -247,3 +249,83 @@ class ArtifactStore:
         df = compute()
         self.save_frame(name, df)
         return df
+
+
+# ------------------------------------- out-of-core factor-stack ingestion
+
+
+def save_factor_stack_chunks(root: str | Path, chunks, *, factor_names,
+                             dates=None, symbols=None) -> Path:
+    """Write a factor stack to disk as factor-axis chunk files + a manifest.
+
+    ``chunks``: an iterable of ``float[C_i, D, N]`` arrays (a generator
+    writes stacks that never exist whole in host memory). Each chunk lands
+    in ``chunk_{i:04d}.npy`` — .npy because it memory-maps zero-copy,
+    which parquet's columnar compression cannot; the manifest
+    (``manifest.json``) records shapes, factor names, and optional
+    date/symbol vocabularies.
+
+    This is the disk half of the north-star deployment path (SURVEY.md
+    section 7 "memory at target scale"): the 20 GB stack streams
+    disk -> (mmap pages) -> device chunk by chunk via
+    :func:`disk_chunk_source`, never materializing a full host copy.
+    """
+    import json
+
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    names = list(factor_names)
+    sizes = []
+    d = n = None
+    for i, chunk in enumerate(chunks):
+        arr = np.ascontiguousarray(np.asarray(chunk, dtype=np.float32))
+        if d is None:
+            d, n = arr.shape[1], arr.shape[2]
+        elif arr.shape[1:] != (d, n):
+            raise ValueError(f"chunk {i} shape {arr.shape[1:]} != {(d, n)}")
+        np.save(root / f"chunk_{i:04d}.npy", arr)
+        sizes.append(int(arr.shape[0]))
+    if sum(sizes) != len(names):
+        raise ValueError(f"chunks hold {sum(sizes)} factors, "
+                         f"{len(names)} names given")
+    manifest = {"sizes": sizes, "d": d, "n": n, "factor_names": names}
+    if dates is not None:
+        manifest["dates"] = [str(x) for x in np.asarray(dates)]
+    if symbols is not None:
+        manifest["symbols"] = [str(x) for x in np.asarray(symbols)]
+    (root / "manifest.json").write_text(json.dumps(manifest))
+    return root
+
+
+def disk_chunk_source(root: str | Path, *, sharding=None):
+    """(source, slices, manifest) over a :func:`save_factor_stack_chunks`
+    directory.
+
+    ``source(i)`` memory-maps chunk i (``np.load(mmap_mode='r')``) and
+    device-puts it — pages stream from the file (or page cache) straight
+    into the transfer, so host memory holds pages transiently instead of a
+    second full-stack copy. ``sharding`` (e.g. ``parallel.chunk_sharding``
+    of a date-sharded mesh) places each chunk directly into its shards —
+    the out-of-core x multi-chip composition end to end from disk.
+
+    Feed the returned ``source``/``len(slices)`` to the
+    ``parallel.streamed_*`` entry points (their ``prefetch`` overlap works
+    unchanged: the mmap read + transfer runs on the prefetch thread).
+    """
+    import json
+
+    import jax
+
+    root = Path(root)
+    manifest = json.loads((root / "manifest.json").read_text())
+    sizes = manifest["sizes"]
+    bounds = np.cumsum([0] + sizes)
+    slices = [slice(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])]
+
+    def source(i):
+        arr = np.load(root / f"chunk_{i:04d}.npy", mmap_mode="r")
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
+        return jnp.asarray(arr)
+
+    return source, slices, manifest

@@ -33,15 +33,31 @@ __all__ = [
 _DATE_AXIS = -2
 
 
-def rolling_sum(x: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp.ndarray:
-    """Trailing-window sum: out[t] = sum(x[t-window+1 : t+1]) (zero-padded edge)."""
+def _rolling_reduce(x: jnp.ndarray, window: int, init, op, axis: int):
+    """Trailing-window reduce_window: out[t] covers x[t-window+1 : t+1]
+    (edge padded with ``init``) — the one home of the window alignment."""
     axis = axis % x.ndim
     dims = [1] * x.ndim
     dims[axis] = window
     pads = [(0, 0)] * x.ndim
     pads[axis] = (window - 1, 0)
-    return lax.reduce_window(x, jnp.zeros((), x.dtype), lax.add, tuple(dims),
+    return lax.reduce_window(x, jnp.asarray(init, x.dtype), op, tuple(dims),
                              (1,) * x.ndim, tuple(pads))
+
+
+def rolling_sum(x: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp.ndarray:
+    """Trailing-window sum: out[t] = sum(x[t-window+1 : t+1]) (zero-padded edge)."""
+    return _rolling_reduce(x, window, 0, lax.add, axis)
+
+
+def rolling_max(x: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp.ndarray:
+    """Trailing-window max (-inf-padded edge)."""
+    return _rolling_reduce(x, window, -jnp.inf, lax.max, axis)
+
+
+def rolling_min(x: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp.ndarray:
+    """Trailing-window min (+inf-padded edge)."""
+    return _rolling_reduce(x, window, jnp.inf, lax.min, axis)
 
 
 def rolling_count(valid: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp.ndarray:

@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from factormodeling_tpu.ops._window import compaction_order, masked_shift, rolling_sum, shift
+from factormodeling_tpu.ops._window import (compaction_order, masked_shift,
+                                            rolling_max, rolling_min,
+                                            rolling_sum, shift)
 
 __all__ = ["ts_regression_fast", "cs_regression", "cs_ols",
            "TS_RETTYPES", "CS_RETTYPES"]
@@ -74,6 +76,18 @@ def ts_regression_fast(y: jnp.ndarray, x: jnp.ndarray, window: int,
     mx, my = sx / window, sy / window
     cov_xy = sxy / window - mx * my
     var_x = sxx / window - mx * mx
+    # Degenerate windows must be NaN exactly like pandas' 0/0: the
+    # reference's `ex2 - mx**2` cancels to an EXACT zero for constant x
+    # whenever the values' squares and sums are representable, but under
+    # jit XLA's FMA contraction computes `mx*mx` unrounded inside the
+    # subtract, leaving +-1-ulp residue — the 0/0-NaN became a finite
+    # garbage beta (caught by the round-5 differential fuzz at soak
+    # depth). Constant-ness is detected structurally (window max == min —
+    # immune to rewrite) instead of via the cancellation.
+    big = jnp.where(cvalid, xc, -jnp.inf)
+    small = jnp.where(cvalid, xc, jnp.inf)
+    const_x = (rolling_max(big, window) == rolling_min(small, window))
+    var_x = jnp.where(const_x, jnp.nan, var_x)
     beta = cov_xy / var_x
     alpha = my - beta * mx
     if rettype == 0:
@@ -86,6 +100,10 @@ def ts_regression_fast(y: jnp.ndarray, x: jnp.ndarray, window: int,
         out = alpha + beta * xc
     else:  # 6: R^2 = cov^2 / (var_x var_y)
         var_y = syy / window - my * my
+        bigy = jnp.where(cvalid, yc, -jnp.inf)
+        smally = jnp.where(cvalid, yc, jnp.inf)
+        const_y = (rolling_max(bigy, window) == rolling_min(smally, window))
+        var_y = jnp.where(const_y, jnp.nan, var_y)
         out = (cov_xy * cov_xy) / (var_x * var_y)
     out = jnp.where(full, out, jnp.nan)
     return jnp.take_along_axis(out, inv, axis=_DATE_AXIS)

@@ -38,6 +38,7 @@ from factormodeling_tpu.parallel.pipeline import (  # noqa: F401
 from factormodeling_tpu.parallel.streaming import (  # noqa: F401
     chunk_slices,
     clear_streaming_cache,
+    chunk_sharding,
     host_array_source,
     streamed_factor_stats,
     streamed_linear_research,

@@ -99,13 +99,20 @@ def free_port() -> int:
 
 def launch(timeout: float = 420.0) -> None:
     """Spawn the 2 worker processes and raise unless both print DIST_OK."""
+    import tempfile
+
     port = free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    # stdout goes to temp FILES, not pipes: a worker dumping a large
+    # traceback would fill a 64 KB pipe and block forever (the launcher
+    # only drains after exit), turning a crisp failure into a timeout
+    logs = [tempfile.NamedTemporaryFile("w+", suffix=f"-dist{r}.log",
+                                        delete=False) for r in range(_NPROC)]
     procs = [subprocess.Popen(
         [sys.executable, "-m", "factormodeling_tpu.parallel._dist_check",
          str(rank), str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        stdout=logs[rank], stderr=subprocess.STDOUT, text=True, env=env)
         for rank in range(_NPROC)]
     # poll both rather than communicate() sequentially: if one worker dies
     # pre-rendezvous the other hangs, and the diagnostic that matters is the
@@ -121,19 +128,26 @@ def launch(timeout: float = 420.0) -> None:
             break
         time.sleep(0.2)
     outs = []
-    for p in procs:
+    for p, log in zip(procs, logs):
         if p.poll() is None:
             p.kill()
-        try:
-            out, _ = p.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            out = "<no output: worker unresponsive after kill>"
-        outs.append(out)
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        if p.returncode != 0 or f"DIST_OK {rank}" not in out:
-            raise RuntimeError(
-                f"distributed worker {rank} failed (rc={p.returncode}, "
-                f"timeout={timed_out}):\n" + out[-4000:])
+            p.wait(timeout=10)
+        log.flush()
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+        os.unlink(log.name)
+    # report the worker that crashed on its own (a killed survivor's rc=-9
+    # is a symptom, not the diagnosis)
+    failed = [(r, p2, out) for r, (p2, out) in enumerate(zip(procs, outs))
+              if p2.returncode != 0 or f"DIST_OK {r}" not in out]
+    if failed:
+        failed.sort(key=lambda t: (t[1].returncode is None
+                                   or t[1].returncode < 0))
+        rank, p2, out = failed[0]
+        raise RuntimeError(
+            f"distributed worker {rank} failed (rc={p2.returncode}, "
+            f"timeout={timed_out}):\n" + out[-4000:])
 
 
 if __name__ == "__main__":

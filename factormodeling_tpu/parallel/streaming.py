@@ -44,11 +44,13 @@ from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from factormodeling_tpu import ops
 from factormodeling_tpu.metrics import daily_factor_stats
 
-__all__ = ["chunk_slices", "clear_streaming_cache", "host_array_source",
+__all__ = ["chunk_sharding", "chunk_slices", "clear_streaming_cache",
+           "host_array_source",
            "streamed_factor_stats", "streamed_linear_research",
            "streamed_weighted_composite"]
 
@@ -96,11 +98,59 @@ def chunk_slices(n_factors: int, chunk: int) -> list[slice]:
             for i in range(0, n_factors, chunk)]
 
 
-def host_array_source(stack, chunk: int):
+
+def _mesh_putters(mesh: Mesh | None, date_axis: str):
+    """(panel_put, chunk_put) for a date-sharded mesh (identity when None).
+
+    Out-of-core and multi-chip compose by sharding the DATE axis of the
+    panels and of every streamed chunk while the factor axis streams
+    serially (SURVEY.md section 7: date-sharding for metric stages,
+    streaming as the memory fallback — round 5 joins them). Inside the
+    per-chunk jits XLA propagates the shardings: cross-sectional
+    reductions stay shard-local, rolling windows halo-exchange, and the
+    selection contraction accumulates date-sharded partials — the round-5
+    equality test pins streamed-sharded == dense-sharded at 1e-10.
+    Non-"date" mesh axes (e.g. a ("factor", "date") research mesh)
+    replicate the streamed arrays on their axis.
+    """
+    if mesh is None:
+        ident = lambda a: a  # noqa: E731
+        return ident, ident
+    panel = NamedSharding(mesh, PartitionSpec(date_axis, None))
+    chunk = chunk_sharding(mesh, date_axis)
+
+    # no jnp.asarray staging: device_put places HOST data directly into the
+    # shards, so a chunk never needs to fit on (or bounce through) a single
+    # device — the point of composing out-of-core with the mesh. Sources
+    # that already return device arrays get resharded; sources returning
+    # numpy (pass ``sharding=`` to :func:`host_array_source`) go straight
+    # from host to their shards.
+    def panel_put(a):
+        return None if a is None else jax.device_put(a, panel)
+
+    def chunk_put(a):
+        return jax.device_put(a, chunk)
+
+    return panel_put, chunk_put
+
+
+def host_array_source(stack, chunk: int, sharding=None):
     """(source, slices) for a host-resident ``float[F, D, N]`` stack; each
-    call device-puts one chunk."""
+    call device-puts one chunk. ``sharding`` (e.g.
+    :func:`chunk_sharding` of a date-sharded mesh) places each chunk
+    DIRECTLY into its shards from host memory — a chunk then never has to
+    fit on one device; without it the chunk lands whole on the default
+    device (single-chip streaming)."""
     slices = chunk_slices(stack.shape[0], chunk)
+    if sharding is not None:
+        return (lambda i: jax.device_put(stack[slices[i]], sharding)), slices
     return (lambda i: jnp.asarray(stack[slices[i]])), slices
+
+
+def chunk_sharding(mesh: Mesh, date_axis: str = "date") -> NamedSharding:
+    """The canonical sharding of a streamed ``[C, D, N]`` chunk on a
+    date-sharded mesh (factor chunks stream serially, dates span devices)."""
+    return NamedSharding(mesh, PartitionSpec(None, date_axis, None))
 
 
 def _prefetched(source, n_chunks: int, prefetch: int):
@@ -136,7 +186,9 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
                           universe: jnp.ndarray | None = None,
                           stats: tuple = ("ic", "rank_ic", "factor_return"),
                           fuse_source: bool = False,
-                          prefetch: int = 0) -> dict:
+                          prefetch: int = 0,
+                          mesh: Mesh | None = None,
+                          date_axis: str = "date") -> dict:
     """Pass 1: per-(factor, date) stats for a streamed stack.
 
     Returns the :func:`daily_factor_stats` dict with every array
@@ -151,12 +203,14 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
     if n_chunks <= 0:
         raise ValueError(f"n_chunks must be positive, got {n_chunks}")
 
+    panel_put, chunk_put = _mesh_putters(mesh, date_axis)
+    returns, universe = panel_put(returns), panel_put(universe)
     one = _stats_kernel(source if fuse_source else None, shift_periods,
                         tuple(stats))
     if fuse_source:
         parts = [one(i, returns, universe) for i in range(n_chunks)]
     else:
-        parts = [one(chunk, returns, universe)
+        parts = [one(chunk_put(chunk), returns, universe)
                  for chunk in _prefetched(source, n_chunks, prefetch)]
     return {k: jnp.concatenate([p[k] for p in parts], axis=0)
             for k in parts[0]}
@@ -200,7 +254,9 @@ def streamed_linear_research(source: Callable[[int], jnp.ndarray],
                              stats: tuple = ("ic", "rank_ic",
                                              "factor_return"),
                              fuse_source: bool = False,
-                             prefetch: int = 0) -> dict:
+                             prefetch: int = 0,
+                             mesh: Mesh | None = None,
+                             date_axis: str = "date") -> dict:
     """SINGLE-pass scoring + selection + blend for factor-separable selectors.
 
     The two-pass flow (:func:`streamed_factor_stats` then
@@ -233,6 +289,12 @@ def streamed_linear_research(source: Callable[[int], jnp.ndarray],
         fresh lambda per call recompiles every kernel on every call (the
         failure mode the cache exists to prevent — see the cache note at
         the top of this module).
+      mesh / date_axis: optional date-sharded mesh composing out-of-core
+        streaming with multi-chip execution (``_mesh_putters``): panels and
+        every chunk are placed date-sharded, the per-chunk kernels run
+        SPMD, and the accumulated composite/norm stay sharded. Fused
+        device sources should capture date-sharded buffers so propagation
+        keeps the chunk computation sharded.
       Other args as :func:`streamed_factor_stats` /
         :func:`streamed_weighted_composite`.
 
@@ -248,6 +310,8 @@ def streamed_linear_research(source: Callable[[int], jnp.ndarray],
         raise ValueError(f"unknown transform {transform!r}; valid: "
                          "'zscore', 'rank', 'none', or a callable")
 
+    panel_put, chunk_put = _mesh_putters(mesh, date_axis)
+    returns, universe = panel_put(returns), panel_put(universe)
     one = _linear_research_kernel(source if fuse_source else None,
                                   chunk_weight_fn, transform, shift_periods,
                                   tuple(stats))
@@ -255,7 +319,8 @@ def streamed_linear_research(source: Callable[[int], jnp.ndarray],
     if fuse_source:
         chunks = iter(range(n_chunks))
     else:
-        chunks = _prefetched(source, n_chunks, prefetch)
+        chunks = (chunk_put(c)
+                  for c in _prefetched(source, n_chunks, prefetch))
     for arg0 in chunks:
         stats_d, u, part = one(arg0, returns, universe)
         stat_parts.append(stats_d)
@@ -302,7 +367,9 @@ def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
                                 *, transform: Callable | str = "zscore",
                                 universe: jnp.ndarray | None = None,
                                 fuse_source: bool = False,
-                                prefetch: int = 0) -> jnp.ndarray:
+                                prefetch: int = 0,
+                                mesh: Mesh | None = None,
+                                date_axis: str = "date") -> jnp.ndarray:
     """Pass 2: ``sum_f w[f, d] * transform(stack)[f, d, n]`` streamed.
 
     Args:
@@ -333,12 +400,15 @@ def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
     if not chunk_weights:
         raise ValueError("chunk_weights is empty")
 
+    panel_put, chunk_put = _mesh_putters(mesh, date_axis)
+    universe = panel_put(universe)
     one = _composite_kernel(source if fuse_source else None, transform)
     total = None
     if fuse_source:
         chunks = iter(range(len(chunk_weights)))
     else:
-        chunks = _prefetched(source, len(chunk_weights), prefetch)
+        chunks = (chunk_put(c)
+                  for c in _prefetched(source, len(chunk_weights), prefetch))
     for w, arg0 in zip(chunk_weights, chunks):
         part = one(arg0, jnp.asarray(w), universe)
         total = part if total is None else total + part

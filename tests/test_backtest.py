@@ -289,12 +289,20 @@ def test_mvo_turnover_with_nans_and_ragged_universe(rng):
     pos_cnt = (np.nan_to_num(masked) > 0).sum(axis=1)
     neg_cnt = (np.nan_to_num(masked) < 0).sum(axis=1)
     # infeasible = an ACTIVE day (both legs populated, so not a flat day)
-    # where a leg cannot reach +-1 under the cap; only those may fall back
+    # where a leg cannot reach +-1 under the cap; only those may fall back.
+    # NaN-signal days are ALSO faithful fallbacks since round 5: the
+    # reference's turnover objective carries the raw signal even at
+    # return_weight=0, so a NaN present-cell fails its cvxpy validation
+    # (portfolio_simulation.py:498-501, 575-583) — its own run warns there,
+    # and so do we
     infeasible = ((pos_cnt > 0) & (neg_cnt > 0)
                   & ((pos_cnt * 0.5 < 1.0) | (neg_cnt * 0.5 < 1.0)))
+    nan_sig = (np.isnan(masked) & universe).any(axis=1)
+    expect_fallback = infeasible.any() or nan_sig.any()
     msgs = check_anomalies(out.diagnostics, warn=False)
-    if infeasible.any():
-        assert all("fell back to equal-weight x0" in m for m in msgs), msgs
+    if expect_fallback:
+        assert msgs and all("fell back to equal-weight x0" in m
+                            for m in msgs), msgs
     else:
         assert msgs == []
 

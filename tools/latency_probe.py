@@ -1,0 +1,34 @@
+import time
+import numpy as np, jax, jax.numpy as jnp
+from factormodeling_tpu.metrics import daily_factor_stats
+
+d, n = 252, 500
+rng = np.random.default_rng(0)
+f = rng.normal(size=(1, d, n)).astype(np.float32)
+f[0][rng.uniform(size=(d, n)) < 0.05] = np.nan
+r = rng.normal(scale=0.02, size=(d, n)).astype(np.float32)
+fd, rd = jnp.asarray(f), jnp.asarray(r)
+step = jax.jit(lambda a, b: daily_factor_stats(a, b, shift_periods=1)["rank_ic"])
+
+def fence(x):
+    return float(jnp.ravel(x)[:8].sum())
+
+fence(step(fd, rd))
+# lone dispatch with fence each time
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter(); fence(step(fd, rd)); ts.append(time.perf_counter() - t0)
+print(f"lone fenced dispatch: {min(ts)*1e3:.1f} ms")
+# async pipeline: K independent dispatches, one fence at the end
+for k in (10, 50):
+    t0 = time.perf_counter()
+    outs = [step(fd, rd) for _ in range(k)]
+    fence(outs[-1])
+    t = time.perf_counter() - t0
+    print(f"async x{k}, fence last: {t/k*1e3:.2f} ms/call")
+# batched dates: one call over K stacked factors
+for k in (10, 50):
+    fk = jnp.asarray(np.repeat(f, k, axis=0))
+    fence(step(fk, rd))
+    t0 = time.perf_counter(); fence(step(fk, rd)); t = time.perf_counter() - t0
+    print(f"batched f={k} single call: {t/k*1e3:.2f} ms/factor")
