@@ -310,3 +310,39 @@ def test_streamed_sharded_matches_dense_sharded(rng):
     np.testing.assert_allclose(np.asarray(st_sharded["rank_ic"]),
                                np.asarray(dense["rank_ic"]), atol=1e-10,
                                equal_nan=True)
+
+
+def test_streamed_fused_device_source_on_mesh(rng):
+    """fuse_source=True composed with the mesh: a device source that slices
+    a DATE-SHARDED resident stack must keep the whole per-chunk computation
+    SPMD (sharded output) and agree with the unsharded result."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from factormodeling_tpu.parallel import make_mesh
+    from factormodeling_tpu.parallel.streaming import (
+        clear_streaming_cache, streamed_factor_stats)
+
+    f, d, n, chunk = 6, 32, 12, 2
+    stack = rng.normal(size=(f, d, n))
+    stack[rng.uniform(size=stack.shape) < 0.05] = np.nan
+    rets = rng.normal(scale=0.02, size=(d, n))
+    mesh = make_mesh(("factor", "date"))
+    sharded_stack = jax.device_put(
+        stack, NamedSharding(mesh, PartitionSpec(None, "date", None)))
+
+    def fused(i):  # traceable: dynamic_slice of the sharded resident stack
+        return jax.lax.dynamic_slice(
+            sharded_stack, (i * chunk, 0, 0), (chunk, d, n))
+
+    try:
+        got = streamed_factor_stats(fused, f // chunk, jnp.asarray(rets),
+                                    stats=("factor_return",),
+                                    fuse_source=True, mesh=mesh)
+        plain = streamed_factor_stats(
+            lambda i: jnp.asarray(stack[i * chunk:(i + 1) * chunk]),
+            f // chunk, jnp.asarray(rets), stats=("factor_return",))
+        np.testing.assert_allclose(np.asarray(got["factor_return"]),
+                                   np.asarray(plain["factor_return"]),
+                                   atol=1e-10, equal_nan=True)
+    finally:
+        clear_streaming_cache()  # the fused kernel pins the sharded stack
