@@ -37,6 +37,9 @@ TPU design notes:
   rho handles poorly.
 - Fixed total iteration count, no data-dependent control flow: one compiled
   kernel, vmappable over dates/combos.
+- Over-relaxation default 1.7: swept 1.5-1.8 on the exact-optimum goldens
+  and a 200-asset self-oracle (round 5) — 1.7 measures best or tied at
+  every budget (e.g. default-budget mean |w - w_opt| 0.0099 -> 0.0091).
 """
 
 from __future__ import annotations
@@ -248,7 +251,7 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
 
 
 def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
-                     iters: int = 500, relax: float = 1.6,
+                     iters: int = 500, relax: float = 1.7,
                      warm_start: ADMMWarmState | None = None) -> ADMMResult:
     """Dense-P path (small n: factor-selection MVO). P must be symmetric PSD.
 
@@ -273,7 +276,7 @@ def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
 
 def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
                        prob: BoxQPProblem, *, rho: float = 2.0,
-                       iters: int = 500, relax: float = 1.6,
+                       iters: int = 500, relax: float = 1.7,
                        warm_start: ADMMWarmState | None = None) -> ADMMResult:
     """Low-rank path: P = diag(alpha) + V' diag(s) V with V: [T, n], T << n.
 

@@ -344,5 +344,9 @@ def test_streamed_fused_device_source_on_mesh(rng):
         np.testing.assert_allclose(np.asarray(got["factor_return"]),
                                    np.asarray(plain["factor_return"]),
                                    atol=1e-10, equal_nan=True)
+        # the per-(factor, date) stats actually stayed SPMD (date-sharded),
+        # not silently gathered to one device
+        assert "date" in str(got["factor_return"].sharding.spec), \
+            got["factor_return"].sharding
     finally:
         clear_streaming_cache()  # the fused kernel pins the sharded stack
