@@ -1,10 +1,10 @@
-"""2-process ``jax.distributed`` correctness check (worker + launcher).
+"""Multi-process ``jax.distributed`` correctness check (worker + launcher).
 
 The reference has no distributed backend at all (SURVEY.md section 2.8);
 this repo's multi-host story is ``parallel/cluster.py`` — and a layout test
 alone does not prove the bring-up path works. This module is the executable
-proof: the launcher spawns two REAL processes on localhost, each with 4
-virtual CPU devices; the workers rendezvous through
+proof: the launcher spawns REAL processes on localhost (default 2 x 4
+virtual CPU devices; CI also runs 4 x 2); the workers rendezvous through
 ``initialize_cluster(coordinator_address=...)`` (the NCCL/MPI-rendezvous
 analog), build the hybrid mesh over the 8 global devices, run the sharded
 research step on identical inputs, and assert the globally-sharded result
@@ -14,7 +14,8 @@ Used by ``tests/test_distributed.py`` (CI) and ``__graft_entry__.
 dryrun_multichip`` (the driver's multi-chip validation).
 
 Worker entry: ``python -m factormodeling_tpu.parallel._dist_check <rank>
-<port>`` — prints ``DIST_OK <rank>`` on success.
+<port> [<n_proc> <local_devices>]`` (the launcher always passes all four)
+— prints ``DIST_OK <rank>`` on success.
 """
 
 from __future__ import annotations
@@ -28,11 +29,12 @@ _NPROC = 2
 _LOCAL_DEVICES = 4
 
 
-def worker(rank: int, port: int) -> None:
+def worker(rank: int, port: int, n_proc: int = _NPROC,
+           local_devices: int = _LOCAL_DEVICES) -> None:
     # must win the platform race against any sitecustomize that points JAX
     # at a real accelerator: config.update before the first backend touch
     os.environ["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={_LOCAL_DEVICES}")
+        f"--xla_force_host_platform_device_count={local_devices}")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -47,12 +49,12 @@ def worker(rank: int, port: int) -> None:
     from factormodeling_tpu.parallel.pipeline import build_research_step
 
     initialize_cluster(coordinator_address=f"127.0.0.1:{port}",
-                       num_processes=_NPROC, process_id=rank)
-    assert jax.process_count() == _NPROC, jax.process_count()
-    assert len(jax.local_devices()) == _LOCAL_DEVICES
-    assert jax.device_count() == _NPROC * _LOCAL_DEVICES
+                       num_processes=n_proc, process_id=rank)
+    assert jax.process_count() == n_proc, jax.process_count()
+    assert len(jax.local_devices()) == local_devices
+    assert jax.device_count() == n_proc * local_devices
 
-    # identical inputs in both processes (same seed)
+    # identical inputs in every process (same seed)
     rng = np.random.default_rng(7)
     f, d, n, window = 8, 32, 16, 6
     names = ["a_eq", "a_flx", "b_long", "b_short",
@@ -69,7 +71,7 @@ def worker(rank: int, port: int) -> None:
     cfg = dict(names=names, window=window,
                sim_kwargs=dict(method="equal", pct=0.3))
     mesh = make_hybrid_mesh(("factor", "date"))
-    assert mesh.devices.size == _NPROC * _LOCAL_DEVICES
+    assert mesh.devices.size == n_proc * local_devices
     step, shard_inputs = make_sharded_research_step(mesh, **cfg)
     sharded = step(*shard_inputs(*raw))
 
@@ -97,8 +99,11 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def launch(timeout: float = 420.0) -> None:
-    """Spawn the 2 worker processes and raise unless both print DIST_OK."""
+def launch(timeout: float = 420.0, n_proc: int = _NPROC,
+           local_devices: int = _LOCAL_DEVICES) -> None:
+    """Spawn the worker processes and raise unless every one prints
+    DIST_OK. Default 2 x 4 devices; the 4 x 2 variant exercises a deeper
+    process topology over the same 8-device global mesh."""
     import tempfile
 
     port = free_port()
@@ -108,13 +113,13 @@ def launch(timeout: float = 420.0) -> None:
     # traceback would fill a 64 KB pipe and block forever (the launcher
     # only drains after exit), turning a crisp failure into a timeout
     logs = [tempfile.NamedTemporaryFile("w+", suffix=f"-dist{r}.log",
-                                        delete=False) for r in range(_NPROC)]
+                                        delete=False) for r in range(n_proc)]
     procs = [subprocess.Popen(
         [sys.executable, "-m", "factormodeling_tpu.parallel._dist_check",
-         str(rank), str(port)],
+         str(rank), str(port), str(n_proc), str(local_devices)],
         stdout=logs[rank], stderr=subprocess.STDOUT, text=True, env=env)
-        for rank in range(_NPROC)]
-    # poll both rather than communicate() sequentially: if one worker dies
+        for rank in range(n_proc)]
+    # poll all workers rather than communicate() sequentially: if one dies
     # pre-rendezvous the other hangs, and the diagnostic that matters is the
     # DEAD worker's output — kill the survivor and report everything
     import time
@@ -151,4 +156,5 @@ def launch(timeout: float = 420.0) -> None:
 
 
 if __name__ == "__main__":
-    worker(int(sys.argv[1]), int(sys.argv[2]))
+    worker(int(sys.argv[1]), int(sys.argv[2]),
+           *(int(a) for a in sys.argv[3:5]))

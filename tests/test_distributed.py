@@ -15,3 +15,10 @@ from factormodeling_tpu.parallel._dist_check import launch
 
 def test_two_process_distributed_research_step():
     launch()
+
+
+def test_four_process_distributed_research_step():
+    """Deeper process topology: 4 processes x 2 devices over the same
+    8-device global mesh — more coordinator participants, smaller
+    addressable shards per process."""
+    launch(n_proc=4, local_devices=2)
