@@ -144,7 +144,13 @@ def ts_std(x: jnp.ndarray, window: int) -> jnp.ndarray:
 @_over_universe
 def ts_zscore(x: jnp.ndarray, window: int) -> jnp.ndarray:
     """(x - rolling mean) / rolling std, std == 0 -> NaN (reference
-    ``operations.py:18-21``)."""
+    ``operations.py:18-21``).
+
+    Documented divergence: the std==0 rule fires DETERMINISTICALLY on every
+    constant window here, while pandas' online rolling kernel is
+    path-dependent — residue carried from preceding window contents can
+    leave std ~1e-17 != 0 and emit 0.0 instead of NaN for the identical
+    window (seed-sweep finding, round 5; see test_ts_zscore)."""
     if _use_streaming(x, window):
         return _pw.ts_zscore_streaming(x, window)
     mean, var, full = _ts_moments(x, window)

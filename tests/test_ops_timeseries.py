@@ -40,7 +40,23 @@ def test_ts_zscore(rng, w):
     # engineered zero-std window: constant run for one symbol
     x[3:3 + w, 0] = 1.25
     s = po.dense_to_long(x)
-    check(ops.ts_zscore(jnp.array(x), w), po.o_ts_zscore(s, w), atol=1e-8)
+    got = np.asarray(ops.ts_zscore(jnp.array(x), w))
+    exp = po.long_to_dense(po.o_ts_zscore(s, w), D, N)
+    # Constant windows: the reference's documented rule is std==0 -> NaN,
+    # and the dense kernel applies it DETERMINISTICALLY. pandas' own online
+    # rolling kernel only sometimes does — residue from the preceding
+    # window contents can leave std ~1e-17 != 0, turning 0/eps into 0.0
+    # (path-dependent; surfaced by the FM_TEST_SEED sweep). Assert our rule
+    # on those cells and exact oracle parity everywhere else.
+    const_win = np.zeros_like(got, dtype=bool)
+    for j in range(N):
+        for i in range(w - 1, D):
+            win = x[i - w + 1:i + 1, j]
+            if not np.isnan(win).any() and np.ptp(win) == 0.0:
+                const_win[i, j] = True
+    assert np.isnan(got[const_win]).all()
+    np.testing.assert_allclose(got[~const_win], exp[~const_win], atol=1e-8,
+                               equal_nan=True)
 
 
 @pytest.mark.parametrize("w", [3, 6])
