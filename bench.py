@@ -785,6 +785,15 @@ def _check_mvo_invariants(out, d, lookback, max_weight, *, warmup=None):
     total = float(np.nansum(np.asarray(out.result.log_return)))
     assert np.isfinite(total), "backtest produced non-finite P&L"
     diag = out.diagnostics
+    # guarded-acceptance sanity: an accepted polish must never report a
+    # residual above the pre-polish one (the guard's own contract)
+    from factormodeling_tpu.backtest import polish_stats as _polish_stats
+
+    acc = np.asarray(diag.polished, bool)
+    if acc.any():
+        pre = np.asarray(diag.polish_pre_residual)[acc]
+        post = np.asarray(diag.polish_post_residual)[acc]
+        assert (post <= pre + 1e-5).all(), "polish accepted a worse residual"
     w = np.asarray(out.weights)[1:]  # weights trade 1 day after the solve
     # QP invariants at scale, on days the solver succeeded (fallback days use
     # the reference's uncapped equal-weight x0, portfolio_simulation.py:452-459)
@@ -805,6 +814,7 @@ def _check_mvo_invariants(out, d, lookback, max_weight, *, warmup=None):
             <= max_weight + cap_tol).all(), "cap violated"
     assert check_anomalies(diag, name="bench", warn=False,
                            residual_tol=0.05) == []
+    return _polish_stats(diag)
 
 
 def _run_mvo_backtest(d, n, *, lookback, max_weight, smoke, profile,
@@ -837,11 +847,10 @@ def _run_mvo_backtest(d, n, *, lookback, max_weight, smoke, profile,
 def bench_mvo_turnover(smoke=False, profile=False):
     """The headline: turnover-penalized MVO backtest at the reference's
     sample shape (1332 dates x 1000 assets, lookback 60). Runs the DEFAULT
-    solver budget — 60 warm-started ADMM iterations with the problem-aware
-    rho since round 5, which measures strictly closer to the exact QP
-    optimum than round 4's published 100-cold-iteration config (the OSQP
-    max_iter=100 parity argument is about solution quality, not iteration
-    counts of a different algorithm; see docs/architecture.md section 12 and
+    solver budget — 40 warm-started ADMM iterations + the guarded
+    active-set polish since round 6, which reaches the exact QP optimum on
+    the goldens (mean |w - w_opt| 4.1e-6 vs round 5's 1.1e-2 at 60
+    iterations without polish; see docs/architecture.md section 12 and
     tests/test_qp_goldens.py). Reference rate: 5.17 s/date (BASELINE.md)."""
     d, n = (64, 64) if smoke else (1332, 1000)
     lookback = 8 if smoke else 60
@@ -851,7 +860,7 @@ def bench_mvo_turnover(smoke=False, profile=False):
         d, n, lookback=lookback, max_weight=max_weight, smoke=smoke,
         profile=profile, trace_name="mvo_turnover",
         method="mvo_turnover", qp_iters=None, turnover_penalty=0.1)
-    _check_mvo_invariants(out, d, lookback, max_weight)
+    polish = _check_mvo_invariants(out, d, lookback, max_weight)
     baseline_s = None if smoke else 5.17 * d
     return _result(f"mvo_turnover_backtest_{d}d_{n}assets_wallclock", seconds,
                    baseline_s=baseline_s,
@@ -862,9 +871,14 @@ def bench_mvo_turnover(smoke=False, profile=False):
                                "weights/result out); ADMM matvecs are "
                                "VMEM-resident",
                    roofline_note="serial-dependency bound: a lax.scan of D "
-                                 "dependent days, each ~100 unrolled ADMM "
+                                 "dependent days, each 40 warm unrolled ADMM "
                                  "iterations of latency-bound [T, N] "
-                                 "matvecs — neither roofline axis binds")
+                                 "matvecs + the guarded active-set polish — "
+                                 "neither roofline axis binds",
+                   extras={"polish_accept_rate":
+                           round(polish["accept_rate"], 4),
+                           "polish_post_residual_p99":
+                           polish["post_residual_p99"]})
 
 
 # ------------------------------------- mvo_turnover at north-star scale
@@ -884,7 +898,7 @@ def bench_mvo_north_star(smoke=False, profile=False):
         d, n, lookback=lookback, max_weight=max_weight, smoke=smoke,
         profile=profile, trace_name="mvo_north_star", repeats=2,
         method="mvo_turnover", qp_iters=None, turnover_penalty=0.1)
-    _check_mvo_invariants(out, d, lookback, max_weight)
+    polish = _check_mvo_invariants(out, d, lookback, max_weight)
     baseline_s = None if smoke else 5.17 * d
     return _result(f"mvo_turnover_{d}d_{n}assets_north_star", seconds,
                    baseline_s=baseline_s,
@@ -897,7 +911,9 @@ def bench_mvo_north_star(smoke=False, profile=False):
                    roofline_note="serial-dependency bound (see the "
                                  "wallclock config)",
                    extras={"target_s": 60.0,
-                           "dates_per_s": round(d / seconds, 1)})
+                           "dates_per_s": round(d / seconds, 1),
+                           "polish_accept_rate":
+                           round(polish["accept_rate"], 4)})
 
 
 # ------------------------------------- risk-model-covariance MVO backtest
@@ -923,8 +939,8 @@ def bench_mvo_risk_model(smoke=False, profile=False):
         profile=profile, trace_name="mvo_risk_model", repeats=2,
         method="mvo_turnover", qp_iters=None, turnover_penalty=0.1,
         covariance="risk_model", **risk_kw)
-    _check_mvo_invariants(out, d, lookback, max_weight,
-                          warmup=risk_kw["risk_refit_every"])
+    polish = _check_mvo_invariants(out, d, lookback, max_weight,
+                                   warmup=risk_kw["risk_refit_every"])
     baseline_s = None if smoke else 5.17 * d
     return _result(f"mvo_risk_model_{d}d_{n}assets", seconds,
                    baseline_s=baseline_s,
@@ -936,7 +952,9 @@ def bench_mvo_risk_model(smoke=False, profile=False):
                                "VMEM-resident",
                    roofline_note="serial-dependency bound (see the "
                                  "mvo_turnover wallclock config)",
-                   extras={"dates_per_s": round(d / seconds, 1)})
+                   extras={"dates_per_s": round(d / seconds, 1),
+                           "polish_accept_rate":
+                           round(polish["accept_rate"], 4)})
 
 
 # ------------------------------------------------------- north star

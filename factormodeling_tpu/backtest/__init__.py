@@ -3,6 +3,7 @@
 from factormodeling_tpu.backtest.diagnostics import (  # noqa: F401
     SolverDiagnostics,
     check_anomalies,
+    polish_stats,
 )
 from factormodeling_tpu.backtest.engine import (  # noqa: F401
     SimulationOutput,

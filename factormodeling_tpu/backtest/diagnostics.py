@@ -18,14 +18,14 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["SolverDiagnostics", "check_anomalies"]
+__all__ = ["SolverDiagnostics", "check_anomalies", "polish_stats"]
 
 
 class SolverDiagnostics(NamedTuple):
     """Per-date solver and invariant telemetry (all ``[D]``).
 
-    primal_residual: ADMM ``max |x - z|`` for the QP schemes; NaN for
-      equal/linear (no solver runs).
+    primal_residual: ADMM ``max |x - z|`` (box/eq residual on polished days)
+      for the QP schemes; NaN for equal/linear (no solver runs).
     solver_ok: False where the QP fell back to the equal-weight ``x0`` for a
       non-deterministic reason (non-finite solution or infeasible caps — the
       reference's ``portfolio_simulation.py:452-459`` except path); the
@@ -34,6 +34,12 @@ class SolverDiagnostics(NamedTuple):
       the quantities the reference checks against +-1.
     active: True on days that actually traded (both legs non-empty and the
       universe large enough); the leg-sum invariant only applies there.
+    polished: True where the active-set polish ran AND its guarded
+      acceptance took the refined point (OSQP paper section 5.2; False on
+      fallback days, with ``qp_polish=False``, and for equal/linear).
+    polish_pre_residual / polish_post_residual: box/equality residual of
+      the exit iterate before / after the polish candidate, NaN where no
+      polish was attempted — ``polish_stats`` aggregates these.
     """
 
     primal_residual: jnp.ndarray
@@ -41,6 +47,37 @@ class SolverDiagnostics(NamedTuple):
     long_sum: jnp.ndarray
     short_sum: jnp.ndarray
     active: jnp.ndarray
+    polished: jnp.ndarray
+    polish_pre_residual: jnp.ndarray
+    polish_post_residual: jnp.ndarray
+
+
+def polish_stats(diag: SolverDiagnostics) -> dict:
+    """Host-side accept-rate / residual summary of the active-set polish.
+
+    ``attempted`` counts days where a polish candidate was evaluated
+    (pre-residual is finite); ``accept_rate`` is accepted / attempted (NaN
+    when nothing was attempted). Residual aggregates are over attempted
+    days only, so they describe what the polish saw, not the ladder."""
+    pre = np.asarray(diag.polish_pre_residual, float)
+    post = np.asarray(diag.polish_post_residual, float)
+    accepted = np.asarray(diag.polished, bool)
+    tried = np.isfinite(pre)
+    n_tried = int(tried.sum())
+    with np.errstate(invalid="ignore"):
+        return {
+            "attempted": n_tried,
+            "accepted": int(accepted.sum()),
+            "accept_rate": (float(accepted.sum() / n_tried) if n_tried
+                            else float("nan")),
+            "pre_residual_mean": float(np.nanmean(pre)) if n_tried else float("nan"),
+            "pre_residual_p99": (float(np.nanpercentile(pre, 99)) if n_tried
+                                 else float("nan")),
+            "post_residual_mean": (float(np.nanmean(post)) if n_tried
+                                   else float("nan")),
+            "post_residual_p99": (float(np.nanpercentile(post, 99)) if n_tried
+                                  else float("nan")),
+        }
 
 
 def check_anomalies(diag: SolverDiagnostics, *, name: str = "simulation",

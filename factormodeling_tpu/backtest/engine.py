@@ -47,20 +47,25 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
     d = signal.shape[0]
     nan_d = jnp.full((d,), jnp.nan, signal.dtype)
     ok_d = jnp.ones((d,), bool)
+    no_polish = (jnp.zeros((d,), bool), nan_d, nan_d)
     if s.method == "equal":
         (w, lc, sc), resid, ok = equal_weights(signal, s.pct), nan_d, ok_d
+        polish = no_polish
     elif s.method == "linear":
         (w, lc, sc), resid, ok = linear_weights(signal, s.max_weight), nan_d, ok_d
+        polish = no_polish
     elif s.method == "mvo":
-        w, lc, sc, resid, ok = mvo_weights(signal, s)
+        w, lc, sc, resid, ok, polish = mvo_weights(signal, s)
     else:  # mvo_turnover
-        w, lc, sc, resid, ok = mvo_turnover_weights(signal, s)
+        w, lc, sc, resid, ok, polish = mvo_turnover_weights(signal, s)
 
     diag = SolverDiagnostics(
         primal_residual=resid, solver_ok=ok,
         long_sum=jnp.maximum(w, 0.0).sum(-1),
         short_sum=jnp.minimum(w, 0.0).sum(-1),
-        active=(lc > 0) & (sc > 0))
+        active=(lc > 0) & (sc > 0),
+        polished=polish[0], polish_pre_residual=polish[1],
+        polish_post_residual=polish[2])
 
     if s.universe is not None:
         shifted = masked_shift(w, s.universe, 1, axis=0)

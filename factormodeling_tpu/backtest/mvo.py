@@ -70,7 +70,8 @@ def _window_factors(returns: jnp.ndarray, today: jnp.ndarray, lookback: int):
     lookback = min(lookback, d)
     start = jnp.maximum(today - lookback, 0)
     t_used = today - start  # number of usable rows
-    rows = lax.dynamic_slice(jnp.nan_to_num(returns), (start, 0), (lookback, n))
+    rows = lax.dynamic_slice(jnp.nan_to_num(returns),
+                             (start, jnp.zeros_like(start)), (lookback, n))
     used = (jnp.arange(lookback) < t_used)[:, None]
     rows = jnp.where(used, rows, 0.0)
     tf = jnp.maximum(t_used, 1).astype(returns.dtype)
@@ -103,8 +104,11 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     ``t_used``). ``None`` -> the reference's trailing sample covariance.
 
     ``warm``: optional (z, u, rho) from a previous related solve — the
-    day-over-day carry mirroring the reference's persistent OSQP warm start
-    (``portfolio_simulation.py:427-437``).
+    day-over-day carry analogous to the reference's scipy path seeding
+    ``x0 = prev_weights`` (``portfolio_simulation.py:676-680``; its cvxpy
+    path passes ``warm_start=True`` but rebuilds the ``cp.Problem`` every
+    date, so nothing carries there — the measured optimality-gap win in
+    docs/architecture.md section 12 is what justifies the carry).
 
     ``force_fallback``: optional bool scalar marking a day the REFERENCE's
     solver rejects before solving, so the ladder must take its equal-x0
@@ -117,10 +121,11 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     differential fuzz. Plain mvo's objective is variance-only (``:399``),
     so it has no such trigger.
 
-    Returns ``(w [N], primal_residual [], solver_ok [], warm_state)`` — the
-    residual and acceptance flag feed :class:`~factormodeling_tpu.backtest.
-    diagnostics.SolverDiagnostics`; ``warm_state`` is the exit iterate for
-    the next day's carry."""
+    Returns ``(w [N], primal_residual [], solver_ok [], warm_state,
+    polish)`` — the residual, acceptance flag, and per-day polish telemetry
+    ``(polished [], pre_residual [], post_residual [])`` feed
+    :class:`~factormodeling_tpu.backtest.diagnostics.SolverDiagnostics`;
+    ``warm_state`` is the exit iterate for the next day's carry."""
     n = signal_row.shape[0]
     dtype = returns.dtype
     pos = signal_row > 0
@@ -151,7 +156,7 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     res = admm_solve_lowrank(2.0 * alpha, c, 2.0 * s_vec, prob,
                              rho=s.qp_rho,
                              iters=s.resolved_qp_iters(turnover),
-                             warm_start=warm)
+                             warm_start=warm, polish=s.qp_polish)
     w = res.x
 
     solver_ok = (jnp.all(jnp.isfinite(w))
@@ -177,6 +182,12 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     # handles them silently by design) — not an anomaly, and their discarded
     # solve has no meaningful residual
     resid = jnp.where(t_used >= 2, res.primal_residual, jnp.nan)
+    # polish telemetry follows the same rule: a discarded solve's polish
+    # stats describe a solution nobody trades
+    solved = solver_ok & (t_used >= 2)
+    polish = (res.polished & solved,
+              jnp.where(solved, res.polish_pre_residual, jnp.nan),
+              jnp.where(solved, res.polish_post_residual, jnp.nan))
     # a REJECTED solve's iterates describe a problem whose solution was
     # discarded (the traded w is the fallback) — carrying them would seed
     # tomorrow's reduced warm budget with an inconsistent start; reset that
@@ -186,7 +197,7 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
         z=jnp.where(solver_ok, state.z, 0.0),
         u=jnp.where(solver_ok, state.u, 0.0),
         rho=jnp.where(solver_ok, state.rho, jnp.nan))
-    return w, resid, solver_ok | (t_used < 2), state
+    return w, resid, solver_ok | (t_used < 2), state, polish
 
 
 def _risk_model_stack(s: SimulationSettings):
@@ -252,7 +263,7 @@ def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
     ``lax.scan`` carrying each lane's ADMM exit state so day t warm-starts
     from day ``t - mvo_batch`` (the closest prior solve in its lane) —
     disable with ``qp_warm_start=False``. Returns
-    (weights [D, N], long_count [D], short_count [D], resid, ok)."""
+    (weights [D, N], long_count [D], short_count [D], resid, ok, polish)."""
     import jax
 
     d, n = signal.shape
@@ -269,28 +280,32 @@ def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
 
     batch = min(s.mvo_batch, d)
     pad = (-d) % batch
-    days = jnp.concatenate([jnp.arange(d),
+    # int32 on both halves: under x64 a bare arange is int64, and the mixed
+    # concat surfaces as an s64/s32 compare the SPMD partitioner rejects
+    days = jnp.concatenate([jnp.arange(d, dtype=jnp.int32),
                             jnp.full((pad,), d - 1, jnp.int32)])
     chunks = days.reshape(-1, batch)
 
     def chunk_step(warm, todays):
-        w, resid, ok, state = jax.vmap(one)(todays, warm)
-        return state, (w, resid, ok)
+        w, resid, ok, state, polish = jax.vmap(one)(todays, warm)
+        return state, (w, resid, ok, polish)
 
-    _, (w, resid, ok) = lax.scan(chunk_step, _cold_state(n, batch, dtype),
-                                 chunks)
+    _, (w, resid, ok, polish) = lax.scan(chunk_step,
+                                         _cold_state(n, batch, dtype), chunks)
     w = w.reshape(-1, n)[:d]
     resid, ok = resid.reshape(-1)[:d], ok.reshape(-1)[:d]
-    return _finalize(w, signal, s, pos, neg, flat, resid, ok)
+    polish = tuple(p.reshape(-1)[:d] for p in polish)
+    return _finalize(w, signal, s, pos, neg, flat, resid, ok, polish)
 
 
 def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
     """Sequential variant: yesterday's (pre-shift) weights feed today's L1
     turnover term (``portfolio_simulation.py:227-248``) -> ``lax.scan``.
     The scan carry also holds the ADMM exit state (z, u, rho), so each day
-    warm-starts from yesterday's solve — the same persistent-solver warm
-    start the reference gets from OSQP (``portfolio_simulation.py:427-437``);
-    disable with ``qp_warm_start=False``."""
+    warm-starts from yesterday's solve — the device analog of the
+    reference's scipy-path ``x0 = prev_weights`` seeding
+    (``portfolio_simulation.py:676-680``); disable with
+    ``qp_warm_start=False``."""
     d, n = signal.shape
     pos, neg, flat = leg_masks(signal)
     # the reference's _get_previous_weights reads the last stored row, which
@@ -300,27 +315,36 @@ def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
     dtype = s.returns.dtype
     # the reference's NaN-signal solver rejection (see _solve_day docstring):
     # a present (universe) cell with a NaN signal value fails its cvxpy data
-    # validation on the turnover objective -> equal-x0 fallback day
-    present = (s.universe if s.universe is not None
-               else jnp.ones(signal.shape, bool))
-    nan_sig_day = (jnp.isnan(signal) & present).any(-1)
+    # validation on the turnover objective -> equal-x0 fallback day. This
+    # rejection semantics needs a universe mask to define "present":
+    # ``universe=None`` declares NO mask, and dense-API callers encoding
+    # absence as NaN then keep the pin-to-zero behavior (NaN signals never
+    # enter a leg) instead of losing whole days to the fallback — the compat
+    # layer always passes the signal's own universe, so reference fidelity
+    # is unaffected.
+    if s.universe is not None:
+        nan_sig_day = (jnp.isnan(signal) & s.universe).any(-1)
+    else:
+        nan_sig_day = jnp.zeros(signal.shape[:-1], bool)
 
     def step(carry, today):
         w_prev, warm = carry
         rm = (None if stacks is None
               else _risk_model_for_day(stacks, today, s))
-        w, resid, ok, state = _solve_day(
+        w, resid, ok, state, polish = _solve_day(
             signal[today], s.returns, today, w_prev, s, turnover=True,
             risk_model=rm, warm=warm if s.qp_warm_start else None,
             force_fallback=nan_sig_day[today])
         w = jnp.where(zero_day[today], 0.0, w)
-        return (w, state), (w, resid, ok)
+        return (w, state), (w, resid, ok, polish)
 
     cold = _cold_state(n, 1, dtype)
     cold = ADMMWarmState(z=cold.z[0], u=cold.u[0], rho=cold.rho[0])
-    _, (w, resid, ok) = lax.scan(step, (jnp.zeros(n, dtype), cold),
-                                 jnp.arange(d))
-    return _finalize(w, signal, s, pos, neg, flat, resid, ok)
+    # int32 days: a bare arange is int64 under x64, and the mixed-width
+    # day-index compares fail HLO verification under SPMD partitioning
+    _, (w, resid, ok, polish) = lax.scan(step, (jnp.zeros(n, dtype), cold),
+                                         jnp.arange(d, dtype=jnp.int32))
+    return _finalize(w, signal, s, pos, neg, flat, resid, ok, polish)
 
 
 def _universe_count(signal: jnp.ndarray, s: SimulationSettings):
@@ -340,7 +364,7 @@ def _no_hist_days(d: int, s: SimulationSettings):
     return days == 0
 
 
-def _finalize(w, signal, s, pos, neg, flat, resid, ok):
+def _finalize(w, signal, s, pos, neg, flat, resid, ok, polish):
     zero_day = flat | (_universe_count(signal, s) < 2)
     w = jnp.where(zero_day[..., None], 0.0, w)
     zero = jnp.zeros_like(pos.sum(-1))
@@ -356,5 +380,10 @@ def _finalize(w, signal, s, pos, neg, flat, resid, ok):
     # flat / no-history days never reach the solver's accept branch; mark
     # them ok so diagnostics only flag genuine solver fallbacks
     ok = ok | zero_day | no_hist
+    # ...and their (discarded) polish telemetry is meaningless
+    dead = zero_day | no_hist
+    polished, pre, post = polish
+    polish = (polished & ~dead, jnp.where(dead, jnp.nan, pre),
+              jnp.where(dead, jnp.nan, post))
     return (w, jnp.where(zero_day, zero, lc), jnp.where(zero_day, zero, sc),
-            resid, ok)
+            resid, ok, polish)

@@ -46,8 +46,37 @@ _RESULT_COLUMNS = ("log_return", "long_return", "short_return",
 # small maxsize bounds pinned HBM (32 x ~5 MB at 1332x1000 f32).
 _DEVICE_PANELS = _IdentityCache(maxsize=32)
 # the run() side product signal*investability, keyed on both operands: the
-# pandas multiply (with index alignment) costs ~0.3 s/sim at 1332x1000
+# pandas multiply (with index alignment) costs ~0.3 s/sim at 1332x1000.
+# Consumers get a copy (see _cow_safe): run() assigns the cached product to
+# self.custom_feature, and an in-place mutation by one consumer must not
+# corrupt the value served to later Simulations over the same inputs.
 _MASKED_SIGNALS = _IdentityCache(maxsize=8)
+
+
+def _pandas_cow_enabled() -> bool:
+    """Whether pandas copy-on-write is active. pandas >= 3 is always-on and
+    REMOVED the ``mode.copy_on_write`` option (reading it raises), so the
+    probe must feature-detect rather than read the option directly."""
+    try:
+        return pd.options.mode.copy_on_write is True
+    except (AttributeError, KeyError, pd.errors.OptionError):
+        return True  # option gone -> pandas >= 3, CoW always on
+
+
+def _cow_safe(series: pd.Series) -> pd.Series:
+    """A copy the caller may mutate without poisoning the cache it came
+    from: shallow under pandas copy-on-write (any write swaps the backing
+    array first), deep otherwise (a shallow copy would share the backing
+    array and write straight through). The deep copy is a plain values
+    memcpy — ~10 ms at 1332x1000 — vs the ~0.3 s aligned multiply the
+    cache exists to save. Either way the ORIGINAL index object is kept, so
+    the identity-keyed vocab/codes caches stay warm for consumers of the
+    copy."""
+    if _pandas_cow_enabled():
+        return series.copy(deep=False)
+    copy = series.copy(deep=True)
+    copy.index = series.index
+    return copy
 
 
 def _device_panel(vocab: PanelVocab, series: pd.Series) -> jnp.ndarray:
@@ -71,9 +100,9 @@ def _fused_run_device(sig, uni, s: _DenseSettings, s_full: _DenseSettings):
     then P&L on the universe-masked weights under the full-grid settings
     (exactly the arrays the pandas weights round trip would rebuild).
 
-    Everything the host consumes per run lands in ONE packed [13, D] f32
+    Everything the host consumes per run lands in ONE packed [16, D] f32
     array, so the pandas boundary pays a single device fetch instead of
-    ~13 relay round trips (counts, six result columns, five diagnostics)."""
+    ~16 relay round trips (counts, six result columns, eight diagnostics)."""
     w, lc, sc, diag = _dense_trade_list(sig, s)
     wv = jnp.where(uni, w, jnp.nan)
     res = _dense_pnl(wv, s_full)
@@ -82,7 +111,8 @@ def _fused_run_device(sig, uni, s: _DenseSettings, s_full: _DenseSettings):
         [getattr(res, c) for c in _RESULT_COLUMNS]
         + [lc.astype(f32), sc.astype(f32), diag.primal_residual,
            diag.solver_ok.astype(f32), diag.long_sum, diag.short_sum,
-           diag.active.astype(f32)])
+           diag.active.astype(f32), diag.polished.astype(f32),
+           diag.polish_pre_residual, diag.polish_post_residual])
     return w, res, packed
 
 
@@ -102,12 +132,14 @@ def _finalize_result(frame: pd.DataFrame, res, symbols: pd.Index,
 
 def _unpack(packed: np.ndarray):
     """(result columns dict, lc, sc, SolverDiagnostics) from the packed
-    [13, D] host array."""
+    [16, D] host array."""
     cols = {c: packed[i] for i, c in enumerate(_RESULT_COLUMNS)}
     lc, sc = packed[6], packed[7]
     diag = SolverDiagnostics(
         primal_residual=packed[8], solver_ok=packed[9] > 0.5,
-        long_sum=packed[10], short_sum=packed[11], active=packed[12] > 0.5)
+        long_sum=packed[10], short_sum=packed[11], active=packed[12] > 0.5,
+        polished=packed[13] > 0.5, polish_pre_residual=packed[14],
+        polish_post_residual=packed[15])
     return cols, lc, sc, diag
 
 
@@ -136,9 +168,13 @@ class SimulationSettings:
     turnover_penalty: float = 0.1
     return_weight: float = 0.0
     # device-solver knobs (compat extras with safe defaults); qp_iters=None
-    # resolves per scheme (500 mvo / 100 mvo_turnover) like the reference's
-    # OSQP max_iter budgets (portfolio_simulation.py:427-437,486-501)
+    # resolves per scheme like the reference's OSQP max_iter budgets
+    # (portfolio_simulation.py:427-437,486-501) — see
+    # backtest.settings.SimulationSettings.resolved_qp_iters. qp_polish is
+    # the OSQP-paper section-5.2 active-set refinement the reference's OSQP
+    # also runs (polish defaults on there too).
     qp_iters: int | None = None
+    qp_polish: bool = True
     mvo_batch: int = 32
     # MVO covariance source (compat extra; the reference is sample-only):
     # "risk_model" swaps the trailing sample window for a rolling
@@ -188,7 +224,8 @@ class Simulation:
             shrinkage_intensity=self.shrinkage_intensity,
             turnover_penalty=self.turnover_penalty,
             return_weight=self.return_weight,
-            qp_iters=self.qp_iters, mvo_batch=self.mvo_batch,
+            qp_iters=self.qp_iters, qp_polish=self.qp_polish,
+            mvo_batch=self.mvo_batch,
             covariance=self.covariance, risk_factors=self.risk_factors,
             risk_lookback=self.risk_lookback,
             risk_refit_every=self.risk_refit_every)
@@ -207,16 +244,20 @@ class Simulation:
         if self.factors_df is not None:
             self.factors_df[self.name] = self.custom_feature
         raw, inv = self.custom_feature, self.investability_flag
-        self.custom_feature = _MASKED_SIGNALS.get(
+        masked = _MASKED_SIGNALS.get(
             (raw, raw._values, inv, inv._values), lambda: raw * inv)
-        sig, uni = self._vocab.densify(self.custom_feature)
+        # the public attribute gets a mutation-safe copy; the cached object
+        # itself feeds densify and the device-panel cache below, so those
+        # stay identity-keyed across Simulations over the same inputs
+        self.custom_feature = _cow_safe(masked)
+        sig, uni = self._vocab.densify(masked)
         weights = None
         if bool(uni.any(axis=1).all()):
             # fast path (every vocab date carries >=1 universe cell, so the
             # two-stage pandas weights round trip is the identity): one
             # fused device dispatch, pandas only at the result boundary
             counts, result, top_longs, top_shorts, w_dense = \
-                self._run_fused(sig, uni)
+                self._run_fused(sig, uni, masked)
         else:
             weights, counts = self._daily_trade_list()
             result, top_longs, top_shorts = \
@@ -249,19 +290,23 @@ class Simulation:
             return result
         return None
 
-    def _run_fused(self, sig: np.ndarray, uni: np.ndarray):
+    def _run_fused(self, sig: np.ndarray, uni: np.ndarray,
+                   masked: pd.Series):
         """One-dispatch run() body (see ``_fused_run_device``). Valid only
         when every vocab date has a universe cell — then the weights' date
         set equals the vocab's and the pandas round trip between the two
         stages is the identity (``_daily_portfolio_returns`` docstring has
-        the edge this guard excludes)."""
+        the edge this guard excludes). ``masked`` is the CACHED
+        signal*investability product (not the mutation-safe copy served on
+        ``self.custom_feature``) so the device-panel key survives across
+        Simulations."""
         vocab = self._vocab
         s = self._dense_settings(uni)
         ones = _DEVICE_PANELS.get(      # per-vocab, reused every run
             (vocab,), lambda: jnp.ones(vocab.shape, bool))
         s_full = dataclasses.replace(s, universe=ones)
         sig_dev = _DEVICE_PANELS.get(
-            (self.custom_feature, self.custom_feature._values, vocab),
+            (masked, masked._values, vocab),
             lambda: jnp.asarray(sig))
         w, res, packed = _fused_run_device(sig_dev, s.universe, s, s_full)
         cols, lc, sc, diag = _unpack(np.asarray(packed))

@@ -45,8 +45,15 @@ def initialize_cluster(coordinator_address: str | None = None,
     NCCL/MPI-rendezvous analog). No-op when already initialized or when the
     process is single-host with no coordination env. Must run before any
     other JAX call touches the backend (``jax.devices()`` etc.)."""
-    if jax.distributed.is_initialized():
-        return
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:  # jax >= 0.5
+        if is_init():
+            return
+    else:  # jax 0.4.x has no public probe; the client handle is the state
+        from jax._src.distributed import global_state as _dist_state
+
+        if getattr(_dist_state, "client", None) is not None:
+            return
     if (coordinator_address is not None or num_processes is not None
             or process_id is not None):
         jax.distributed.initialize(coordinator_address=coordinator_address,
@@ -62,7 +69,9 @@ def initialize_cluster(coordinator_address: str | None = None,
             return  # no cluster environment detected -> single process
         raise  # a cluster WAS detected but bring-up failed: surface it
     except RuntimeError as e:
-        if "before any JAX calls" in str(e):
+        # message wording varies by jax version ("before any JAX calls" /
+        # "before any JAX computations are executed")
+        if "before any JAX" in str(e):
             return  # backend already up in a single-process session
         raise
 

@@ -23,7 +23,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
-from jax import shard_map
+
+try:  # jax >= 0.5 exposes shard_map at the top level
+    from jax import shard_map
+except ImportError:  # jax 0.4.x: experimental namespace, same semantics
+    from jax.experimental.shard_map import shard_map
 
 from factormodeling_tpu.backtest.pnl import daily_portfolio_returns
 from factormodeling_tpu.backtest.settings import SimulationSettings

@@ -344,7 +344,8 @@ def optimal_weights(model: RiskModel, signal: jnp.ndarray, *,
                     max_weight: float = 0.03, return_weight: float = 0.0,
                     turnover_penalty: float = 0.0,
                     prev_weights: jnp.ndarray | None = None,
-                    qp_iters: int = 500, rho: float = 2.0):
+                    qp_iters: int = 500, rho: float = 2.0,
+                    polish: bool = True):
     """Dollar-neutral long/short MVO under the statistical risk model.
 
     The backtest engine's constraint set (reference
@@ -380,7 +381,7 @@ def optimal_weights(model: RiskModel, signal: jnp.ndarray, *,
     # reference objective is w' Sigma w (not halved): P = 2 Sigma
     res = admm_solve_lowrank(2.0 * model.idio_var, model.loadings.T,
                              2.0 * model.factor_var, prob,
-                             rho=rho, iters=qp_iters)
+                             rho=rho, iters=qp_iters, polish=polish)
     w = res.x
     ok = jnp.all(jnp.isfinite(w)) & legs_feasible(sig, max_weight)
     return (jnp.where(ok, w, equal_leg_fallback(sig)), res.primal_residual, ok)

@@ -40,6 +40,22 @@ TPU design notes:
 - Over-relaxation default 1.7: swept 1.5-1.8 on the exact-optimum goldens
   and a 200-asset self-oracle (round 5) — 1.7 measures best or tied at
   every budget (e.g. default-budget mean |w - w_opt| 0.0099 -> 0.0091).
+- Active-set polish (round 6, the OSQP paper's section 5.2): at exit the
+  box/L1 active set is read off ``z`` (the prox step lands EXACTLY on
+  lo/hi/center, so identification is an equality test, not a tolerance),
+  and the reduced equality-constrained KKT system on the free coordinates
+  is solved and re-identified over ``_POLISH_PASSES`` guarded active-set
+  passes — masked rows under fixed shapes, so the step stays
+  ``vmap``/``scan``-compatible, and every reduced solve reuses the same
+  Woodbury/Cholesky machinery as the x-step (O(nT + nK), never n x n for
+  the low-rank path). The polished point is accepted only under a guard
+  (feasibility no worse AND objective no worse than the box-projected
+  iterate, with a dual-scaled slack) mirroring OSQP's guarded acceptance,
+  so polish can never degrade the returned solution. On the exact-optimum
+  goldens this turns a finite-budget near-vertex iterate into the exact
+  optimum (mean |w - w_opt| 1.1e-2 -> 4.1e-6 on the turnover scheme, every
+  day accepted, at 2/3 the round-5 iteration budget) — the structural
+  escape from iteration-count tuning (docs/architecture.md section 12).
 """
 
 from __future__ import annotations
@@ -70,23 +86,31 @@ class BoxQPProblem:
 
 
 class ADMMResult(NamedTuple):
-    x: jnp.ndarray          # equality-exact iterate
-    z: jnp.ndarray          # box/L1-exact iterate
-    primal_residual: jnp.ndarray  # max |x - z|
+    x: jnp.ndarray          # equality-exact iterate (polished when accepted)
+    z: jnp.ndarray          # box/L1-exact iterate (loop exit; warm carry)
+    primal_residual: jnp.ndarray  # max |x - z|; box/eq residual if polished
     u: jnp.ndarray          # scaled dual at exit (warm-start carry)
     rho: jnp.ndarray        # adapted penalty at exit (warm-start carry)
+    polished: jnp.ndarray   # bool: active-set polish ran AND was accepted
+    polish_pre_residual: jnp.ndarray   # box/eq residual before polish (NaN
+    polish_post_residual: jnp.ndarray  # / after; NaN when polish disabled)
 
     @property
     def warm_state(self) -> "ADMMWarmState":
-        """The (z, u, rho) triple to feed the next related solve."""
+        """The (z, u, rho) triple to feed the next related solve. Always the
+        LOOP-EXIT iterates: the polish is output-side only, so warm-start
+        dynamics are identical with it on or off."""
         return ADMMWarmState(z=self.z, u=self.u, rho=self.rho)
 
 
 class ADMMWarmState(NamedTuple):
     """Warm-start state from a previous, related solve — the day-over-day
-    carry the reference gets from OSQP's ``warm_start=True`` (its solver
-    object persists x/y across dates, ``portfolio_simulation.py:427-437``;
-    the scipy path seeds ``x0 = prev_weights``, ``:676-680``). ``z`` is
+    carry analogous to the reference's scipy path seeding
+    ``x0 = prev_weights`` (``portfolio_simulation.py:676-680``). (Its cvxpy
+    path passes ``warm_start=True`` but constructs a fresh ``cp.Problem``
+    every date, so no solver state actually persists there; the measured
+    optimality-gap improvement — docs/architecture.md section 12 — is the
+    justification for this feature, not cvxpy parity.) ``z`` is
     clipped into the new problem's box before use; ``u`` is the scaled
     dual in the solver's internal objective scaling (day-over-day scale
     drift just perturbs the start, never correctness). ``rho`` records the
@@ -109,6 +133,252 @@ _ADAPT_EVERY = 25          # iterations per segment between rho updates
 _UNROLL = 25               # TPU inner-loop unroll factor (see _unroll_factor)
 _RHO_STEP_CLIP = 5.0       # max per-update rho movement factor
 _RHO_BOUNDS = (1e-4, 1e7)  # global rho clamp (scaled problem units)
+_POLISH_DELTA = 1e-8       # polish KKT regularization (scaled units; the
+                           # OSQP paper uses 1e-6 + iterative refinement —
+                           # same scheme, one refinement step below)
+_POLISH_PASSES = 6         # active-set refinement passes: swept 2-10 on the
+                           # exact-optimum goldens — accept saturates at
+                           # 27/27 by 6 passes at the default warm budget
+                           # (5 passes: 26/27; extra passes are idempotent)
+_POLISH_RES_TOL = 1e-6     # acceptance slack on the box/eq residual
+_POLISH_OBJ_TOL = 1e-5     # relative acceptance slack on the objective
+_POLISH_REL_TOL = 1e-6     # relative band for the release/keep dual tests
+                           # (sized for f32 production gradients)
+_POLISH_RELEASE_GATE = 5e-2  # a pass may RELEASE active coords only when its
+                           # candidate is this feasible — multiplier reads
+                           # off a GARBAGE candidate (box violations ~1e-1+,
+                           # from a blasted side or an under-active leg) are
+                           # noise, and acting on them was measured to
+                           # cascade into release storms; but candidates a
+                           # few 1e-2 from feasible carry sound reads, and
+                           # gating those out deadlocks the over-pinned days
+                           # (swept 1e-4..5e-2: tight gates cap the goldens
+                           # at 26/27 accepted, 5e-2 reaches 27/27)
+_POLISH_BLAST = 10.0       # box-violation factor (x the box scale) that marks
+                           # a free coordinate's L1 SIDE as wrong rather than
+                           # the bound as active: a wrong side mis-signs the
+                           # linear term by 2*l1 (~1e2 scaled), blasting the
+                           # coordinate orders of magnitude past the box,
+                           # while genuine to-be-joined coords overshoot by
+                           # O(|b_red|) ~ 1e-1 — the two regimes are separated
+                           # by ~3 decades on the goldens
+
+
+def _box_eq_residual(prob: BoxQPProblem, v):
+    """max(box violation, |E v - b|_inf) — the polish feasibility metric.
+    One definition shared by the pass loop's best-candidate selection and
+    the acceptance guard, which must score candidates identically."""
+    box = jnp.max(jnp.maximum(jnp.maximum(prob.lo - v, v - prob.hi), 0.0))
+    return jnp.maximum(box, jnp.max(jnp.abs(prob.E @ v - prob.b)))
+
+
+def _qp_objective(mv, prob: BoxQPProblem, q, l1, v):
+    """Scaled objective 1/2 v'Pv + q'v + sum l1 |v - center| (same sharing
+    contract as :func:`_box_eq_residual`)."""
+    l1v = jnp.broadcast_to(jnp.asarray(l1, q.dtype), v.shape)
+    return (0.5 * (v @ mv(v)) + q @ v
+            + jnp.sum(l1v * jnp.abs(v - prob.center)))
+
+
+def _reduced_kkt_solve(mv, masked_solver, prob: BoxQPProblem, q, m, xa, qt):
+    """Solve the reduced equality-constrained QP of one polish pass:
+
+        min 1/2 y' (M P M) y + qt' y   s.t.  (E M) y = b - E x_a,
+
+    with masked rows (``M = diag(m)``, fixed shapes) and one
+    iterative-refinement step against the unregularized KKT operator, as in
+    the OSQP polish. ``masked_solver(m)`` applies
+    ``(M P M + diag(1 - m) + delta I)^{-1}`` — the active block decoupled to
+    identity, so the masked rhs keeps active components at exactly zero.
+    Returns ``(x_candidate, nu)``."""
+    dtype = q.dtype
+    b_red = prob.b - prob.E @ xa
+    em = prob.E * m                                  # [K, n] masked rows
+    solve_h = masked_solver(m)
+    minv_et = solve_h(em.T)                          # [n, K]
+    g = em @ minv_et                                 # [K, K]
+    # ridge keeps a fully-active leg (zero row in em) solvable; the guard
+    # rejects the garbage candidate that case produces
+    g = g + _POLISH_DELTA * jnp.eye(g.shape[0], dtype=dtype)
+    g_lu = jax.scipy.linalg.lu_factor(g)
+
+    def kkt(r1, r2):
+        y0 = solve_h(r1)
+        nu = jax.scipy.linalg.lu_solve(g_lu, em @ y0 - r2)
+        return y0 - minv_et @ nu, nu
+
+    y, nu = kkt(-qt, b_red)
+    # one refinement step against the unregularized operator (the delta on
+    # the free diagonal and the G ridge are the only perturbations; the
+    # active-block identity is exact — its rhs components are zero)
+    r1 = -qt - (m * mv(m * y) + (1.0 - m) * y) - em.T @ nu
+    r2 = b_red - em @ y
+    dy, dnu = kkt(r1, r2)
+    return xa + m * (y + dy), nu + dnu
+
+
+def _polish_candidate(mv, masked_solver, prob: BoxQPProblem, q, l1, z):
+    """Active-set KKT refinement candidate (OSQP paper section 5.2), batched
+    and fixed-shape.
+
+    The prox (z-step) is a closed-form soft-threshold-then-clip, so its exit
+    iterate lands EXACTLY on ``lo``/``hi`` when the box clips and EXACTLY on
+    ``center`` when the L1 threshold holds — the initial active set is an
+    equality read, no tolerance needed. Active coordinates are fixed at
+    their bound / the L1 kink; free coordinates carry the identified L1
+    slope ``l1 * side`` as a linear term and solve the reduced
+    equality-constrained QP (:func:`_reduced_kkt_solve`).
+
+    Where OSQP polishes once from termination-grade duals, a fixed-budget
+    exit can mis-identify — so the pass REPEATS ``_POLISH_PASSES`` times,
+    re-reading the active set off each candidate's own KKT conditions
+    (primal: bound violations and kink crossings join the active set; dual:
+    active coordinates whose implied multiplier leaves its cone/band are
+    released). Two safeguards keep the iteration from the cycling every
+    textbook active-set method warns about, both measured necessary on the
+    exact-optimum goldens at the small warm budget:
+
+    - releases only fire when the pass's candidate is near-feasible
+      (``_POLISH_RELEASE_GATE``): multiplier estimates read off an
+      infeasible candidate are noise, and acting on them cascades — one
+      bad release freed five more coordinates two passes later and sent
+      the candidate to |x| ~ 1e2;
+    - the BEST candidate across passes (feasibility, then objective) is
+      returned, so a late destabilized pass cannot undo an earlier good
+      one and extra passes are monotone.
+
+    Returns ``(x_polished, nu)`` — nu (the reduced equality multipliers of
+    the returned candidate) feeds the acceptance guard's dual-scaled
+    objective slack.
+    """
+    dtype = q.dtype
+    l1v = jnp.broadcast_to(jnp.asarray(l1, dtype), z.shape)
+    pinned = prob.hi <= prob.lo
+    at_lo = z <= prob.lo
+    at_hi = z >= prob.hi
+    # a kink OUTSIDE the box is unreachable — the optimum clips at the bound
+    # instead. This is common in the turnover scan: yesterday's traded
+    # weights (today's center) sit past today's cap after leg renorm, or on
+    # the wrong side of zero after a leg flip. Pinning such a coordinate at
+    # its center would bake a permanent box violation into every candidate
+    # (and that violation then gates all releases), so it is never kinkable.
+    kinkable = (prob.center >= prob.lo) & (prob.center <= prob.hi)
+    at_kink = (l1v > 0) & kinkable & (z == prob.center) & ~at_lo & ~at_hi
+    side = jnp.sign(z - prob.center)
+    # extremal L1 subgradients at each bound: when the bound COINCIDES with
+    # the center (a very common turnover case — zero prev weight at lo = 0)
+    # the whole [-l1, l1] band is available there, so the keep/release test
+    # must use the band edge, not a point subgradient
+    smax_lo = jnp.where(prob.lo >= prob.center, 1.0, -1.0).astype(dtype)
+    smin_hi = jnp.where(prob.hi <= prob.center, -1.0, 1.0).astype(dtype)
+
+    big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+
+    # One pass of the guarded active-set iteration. Runs under lax.fori_loop
+    # (the body is shape-invariant): the compiled graph holds ONE pass body
+    # instead of _POLISH_PASSES inlined copies — measured to matter for
+    # compile time in every jitted QP consumer — and the pass count stops
+    # being a compile-size concern. (The TPU unroll preference of the main
+    # ADMM loop does not apply here: this body is a few heavyweight
+    # matmul/Cholesky ops, not latency-bound small matvecs.)
+    def one_pass(_, carry):
+        at_lo, at_hi, at_kink, side, best = carry
+        active = at_lo | at_hi | at_kink
+        m = (~active).astype(dtype)
+        x_fix = jnp.where(at_kink, prob.center,
+                          jnp.where(at_hi, prob.hi, prob.lo))
+        xa = jnp.where(active, x_fix, 0.0)
+        qt = (q + l1v * side + mv(xa)) * m
+        x_p, nu = _reduced_kkt_solve(mv, masked_solver, prob, q, m, xa, qt)
+
+        finite = jnp.all(jnp.isfinite(x_p))
+        f_p = jnp.where(finite, _box_eq_residual(prob, x_p), big)
+        o_p = jnp.where(finite, _qp_objective(mv, prob, q, l1, x_p), big)
+        better = (f_p < best[0] - _POLISH_RES_TOL) | (
+            (f_p <= best[0] + _POLISH_RES_TOL) & (o_p < best[1]))
+        best = (jnp.where(better, f_p, best[0]),
+                jnp.where(better, o_p, best[1]),
+                jnp.where(better, x_p, best[2]),
+                jnp.where(better, nu, best[3]))
+
+        # re-identify from the candidate's KKT conditions. gtot is the
+        # smooth gradient P x + q + E'nu; optimality needs
+        # -gtot in l1*d|x - center| + N_box(x) per coordinate.
+        gtot = mv(x_p) + q + prob.E.T @ nu
+        tol = _POLISH_REL_TOL * (l1v + jnp.abs(gtot)) + jnp.finfo(dtype).tiny
+        free = m > 0
+        # a free coordinate ejected far past the box did not find a new
+        # active bound — its L1 SIDE was wrong (the solve's own stationarity
+        # can never contradict the side it was given, so the only visible
+        # symptom of a wrong side is this blast). Flip the side to the
+        # direction it ran and re-solve; do NOT join it to the bound it
+        # blew through.
+        box_scale = 1.0 + jnp.max(jnp.maximum(jnp.abs(prob.lo),
+                                              jnp.abs(prob.hi)))
+        viol = jnp.maximum(prob.lo - x_p, x_p - prob.hi)
+        blast = free & (l1v > 0) & (viol > _POLISH_BLAST * box_scale)
+        side = jnp.where(blast, jnp.sign(x_p - prob.center), side)
+        # primal: free coords that left the box or crossed their kink. A
+        # coordinate that crossed BOTH (ran through the kink and out the far
+        # bound — the L1 slope dominates the quadratic pull, so a freed
+        # true-kink coordinate does exactly that) prefers the KINK: it is
+        # the first nonsmooth point along its path, and an over-eager kink
+        # is released by a later pass while a wrongly-joined bound sticks.
+        crossed = (free & ~blast & (l1v > 0) & kinkable
+                   & (side * (x_p - prob.center) < 0))
+        join_lo = free & ~blast & ~crossed & (x_p < prob.lo)
+        join_hi = free & ~blast & ~crossed & (x_p > prob.hi)
+        # dual: active coords whose multiplier leaves its cone/band.
+        # lower bound keeps -gtot <= l1 * smax_lo (box normal cone is
+        # (-inf, 0] there), upper keeps -gtot >= l1 * smin_hi, kink keeps
+        # |gtot| <= l1; pinned coords (lo == hi) never release, and no
+        # coord releases off an infeasible candidate (see above)
+        may_release = (finite
+                       & (f_p <= _POLISH_RELEASE_GATE
+                          * (1.0 + jnp.max(jnp.abs(prob.b)))))
+        rel_lo = at_lo & ~pinned & may_release & (-gtot - l1v * smax_lo > tol)
+        rel_hi = at_hi & ~pinned & may_release & (-gtot - l1v * smin_hi < -tol)
+        rel_kink = at_kink & may_release & (jnp.abs(gtot) > l1v + tol)
+        # released coords re-enter free on the side of the kink their bound
+        # sits on (the band-edge subgradient sign), until a later pass sees
+        # them cross
+        side = jnp.where(rel_kink, -jnp.sign(gtot), side)
+        side = jnp.where(rel_lo, smax_lo, side)
+        side = jnp.where(rel_hi, smin_hi, side)
+        # deadlock breaker: a leg whose coordinates are ALL pinned but whose
+        # equality is unmet can never repair itself — joins need a free
+        # coordinate and the infeasibility itself holds the release gate
+        # shut. Release every coordinate of that leg that can move toward
+        # the deficit; the next pass's joins/crossings re-pin the right
+        # ones. (Measured: exactly this state — an over-pinned long leg
+        # 0.15 short of +1 — was the terminal fixed point of the two
+        # stubborn golden days.)
+        deficit = prob.b - prob.E @ x_p
+        leg_dead = ((jnp.abs(deficit)
+                     > _POLISH_RES_TOL * (1.0 + jnp.max(jnp.abs(prob.b))))
+                    & ((prob.E * m).sum(-1) <= 0))
+        need_up = (prob.E.T @ jnp.where(leg_dead & (deficit > 0),
+                                        1.0, 0.0)) > 0
+        need_dn = (prob.E.T @ jnp.where(leg_dead & (deficit < 0),
+                                        1.0, 0.0)) > 0
+        brk_lo = at_lo & ~pinned & need_up
+        brk_hi = at_hi & ~pinned & need_dn
+        brk_kink = at_kink & (need_up | need_dn)
+        side = jnp.where(brk_lo, smax_lo, side)
+        side = jnp.where(brk_hi, smin_hi, side)
+        side = jnp.where(brk_kink, jnp.where(need_up, 1.0, -1.0), side)
+        at_lo = (at_lo & ~rel_lo & ~brk_lo) | join_lo
+        at_hi = (at_hi & ~rel_hi & ~brk_hi) | join_hi
+        at_kink = (((at_kink & ~rel_kink & ~brk_kink) | crossed)
+                   & ~at_lo & ~at_hi)
+        return at_lo, at_hi, at_kink, side, best
+
+    n = q.shape[-1]
+    k = prob.b.shape[-1]
+    best0 = (big, big, jnp.zeros(n, dtype), jnp.zeros(k, dtype))
+    _, _, _, _, best = lax.fori_loop(
+        0, _POLISH_PASSES, one_pass, (at_lo, at_hi, at_kink, side, best0))
+    return best[2], best[3]
 
 
 def _unroll_factor() -> int:
@@ -125,7 +395,7 @@ def _unroll_factor() -> int:
 
 
 def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
-                     relax, warm=None):
+                     relax, warm=None, polish_ops=None):
     """Shared ADMM loop with residual-balanced adaptive rho.
 
     ``make_solver(rho)`` returns a function applying (P + rho I)^{-1}; it is
@@ -133,6 +403,12 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
     equality-constrained x-step is
         x = xt - Minv_Et @ nu,  nu = G^{-1} (E xt - b),
     with xt = solve_m(rho (z - u) - q), Minv_Et = solve_m(E'), G = E Minv_Et.
+
+    ``polish_ops``: ``None`` disables the exit polish; otherwise a pair
+    ``(mv, masked_solver)`` — ``mv(v)`` applies the scaled P, and
+    ``masked_solver(m)`` returns a function applying
+    ``(M P M + diag(1 - m) + delta I)^{-1}`` for the free-coordinate mask
+    ``m`` (see :func:`_polish_candidate`).
     """
     n = q.shape[-1]
     dtype = q.dtype
@@ -245,20 +521,52 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
             n_seg = max(-(-iters // _ADAPT_EVERY), 1)  # ceil: total == iters
             carry = lax.fori_loop(0, n_seg, seg_k, carry)
         x, z, u, rho = carry
-        x = x_step(factor(rho), z, u, rho)  # final equality-exact polish
-    return ADMMResult(x=x, z=z, primal_residual=jnp.max(jnp.abs(x - z)),
-                      u=u, rho=rho)
+        x = x_step(factor(rho), z, u, rho)  # final equality-exact x-step
+        prim = jnp.max(jnp.abs(x - z))
+        nan = jnp.full((), jnp.nan, dtype)
+        if polish_ops is None:
+            accepted = jnp.zeros((), bool)
+            pre_r = post_r = nan
+        else:
+            mv, masked_solver = polish_ops
+            x_p, nu = _polish_candidate(mv, masked_solver, prob, q, l1, z)
+
+            # Guarded acceptance, mirroring OSQP's: the polished point must
+            # be (a) no less feasible than the exit x and (b) no worse in
+            # objective than the BOX-PROJECTED exit iterate. The projection
+            # makes (b) a feasible-vs-feasible comparison; its remaining
+            # equality drift (<= K * pre-residual) can push the projected
+            # objective below the true optimum by at most |nu|_1 * drift, so
+            # the slack carries that dual-scaled term — without it, a
+            # correct polish of a loose f32 iterate is spuriously rejected.
+            pre_r = _box_eq_residual(prob, x)
+            post_r = _box_eq_residual(prob, x_p)
+            obj_ref = _qp_objective(mv, prob, q, l1,
+                                    jnp.clip(x, prob.lo, prob.hi))
+            slack = (_POLISH_OBJ_TOL * (1.0 + jnp.abs(obj_ref))
+                     + jnp.abs(nu).sum() * pre_r)
+            accepted = (jnp.all(jnp.isfinite(x_p))
+                        & (post_r <= pre_r + _POLISH_RES_TOL)
+                        & (_qp_objective(mv, prob, q, l1, x_p)
+                           <= obj_ref + slack))
+            x = jnp.where(accepted, x_p, x)
+            prim = jnp.where(accepted, post_r, prim)
+    return ADMMResult(x=x, z=z, primal_residual=prim, u=u, rho=rho,
+                      polished=accepted, polish_pre_residual=pre_r,
+                      polish_post_residual=post_r)
 
 
 def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
                      iters: int = 500, relax: float = 1.7,
-                     warm_start: ADMMWarmState | None = None) -> ADMMResult:
+                     warm_start: ADMMWarmState | None = None,
+                     polish: bool = True) -> ADMMResult:
     """Dense-P path (small n: factor-selection MVO). P must be symmetric PSD.
 
     ``rho`` is the initial penalty; residual balancing adapts it every
     ``_ADAPT_EVERY`` iterations. Exactly ``iters`` iterations run.
     ``warm_start`` seeds (z, u, rho) from a previous related solve
-    (``ADMMResult.warm_state``)."""
+    (``ADMMResult.warm_state``). ``polish`` runs the guarded active-set KKT
+    refinement at exit (one extra masked Cholesky solve)."""
     n = P.shape[-1]
     scale = jnp.maximum(jnp.trace(P) / n, 1e-12)
     Ps = P / scale
@@ -270,14 +578,25 @@ def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
         chol = jax.scipy.linalg.cho_factor(Ps + rho * eye)
         return lambda r: jax.scipy.linalg.cho_solve(chol, r)
 
+    def mv(v):
+        return Ps @ v
+
+    def masked_solver(m):
+        h = (Ps * (m[:, None] * m[None, :])
+             + jnp.diag((1.0 - m) + _POLISH_DELTA))
+        chol = jax.scipy.linalg.cho_factor(h)
+        return lambda r: jax.scipy.linalg.cho_solve(chol, r)
+
     return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax,
-                            warm=warm_start)
+                            warm=warm_start,
+                            polish_ops=(mv, masked_solver) if polish else None)
 
 
 def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
                        prob: BoxQPProblem, *, rho: float = 2.0,
                        iters: int = 500, relax: float = 1.7,
-                       warm_start: ADMMWarmState | None = None) -> ADMMResult:
+                       warm_start: ADMMWarmState | None = None,
+                       polish: bool = True) -> ADMMResult:
     """Low-rank path: P = diag(alpha) + V' diag(s) V with V: [T, n], T << n.
 
     ``alpha`` is a scalar (the backtest's shrinkage/jitter identity,
@@ -291,7 +610,11 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
     (each update re-runs the T x T factorization only). Exactly ``iters``
     iterations run. ``warm_start`` seeds (z, u, rho) from a previous related
     solve (``ADMMResult.warm_state``) — the day-over-day carry in
-    ``backtest/mvo.py``'s schemes.
+    ``backtest/mvo.py``'s schemes. ``polish`` runs the guarded active-set KKT
+    refinement at exit; its reduced solve rides the same Woodbury identity
+    with masked V columns and the active coordinates decoupled on the
+    diagonal, so it stays O(nT + T^3) — one extra "refactor"-sized solve per
+    problem, paid once, not per iteration.
     """
     t, n = V.shape
     alpha = jnp.asarray(alpha)
@@ -326,5 +649,25 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
 
         return solve_m
 
+    def mv(v):
+        return a * v + V.T @ (ss * (V @ v))
+
+    def masked_solver(m):
+        # M P M + diag(1 - m) + delta I keeps the Woodbury structure: a
+        # vector diagonal (the free idio terms, identity on the active
+        # block) plus masked low-rank columns V * m
+        d = a * m + (1.0 - m) + _POLISH_DELTA
+        vm = V * m
+        inner_chol = jax.scipy.linalg.cho_factor(inv_ss + (vm / d) @ vm.T)
+
+        def solve_m(r):
+            dd = d[:, None] if r.ndim == 2 else d
+            rd = r / dd
+            corr = (vm.T @ jax.scipy.linalg.cho_solve(inner_chol, vm @ rd)) / dd
+            return rd - corr
+
+        return solve_m
+
     return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax,
-                            warm=warm_start)
+                            warm=warm_start,
+                            polish_ops=(mv, masked_solver) if polish else None)

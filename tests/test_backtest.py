@@ -390,7 +390,7 @@ def test_risk_model_day_matches_direct_optimal_weights(rng):
     s = settings_for(returns, cap, invest, method="mvo",
                      covariance="risk_model", risk_factors=3,
                      risk_lookback=lb, risk_refit_every=cad, max_weight=0.4)
-    w, lc, sc, resid, ok = mvo_weights(jnp.array(signal), s)
+    w, lc, sc, resid, ok, _polish = mvo_weights(jnp.array(signal), s)
 
     today = 3 * cad + 2  # block 3: fit on rows [8, 24)
     model = risk.statistical_risk_model(
@@ -445,3 +445,60 @@ def test_equal_scheme_tie_rule_is_deterministic_first_index():
     w = np.asarray(w[0])
     assert lc[0] == 1 and sc[0] == 1
     np.testing.assert_allclose(w, [0.0, 1.0, 0.0, 0.0, -1.0, 0.0])
+
+
+def test_universe_none_nan_signals_keep_pin_to_zero(rng):
+    """The ``universe=None`` contract (round-5 advisor, low): with no
+    universe mask, NaN signal cells mean "absent" to dense-API callers and
+    are pinned to zero — the reference's NaN-signal cvxpy rejection (which
+    forces whole days to the equal-x0 fallback) only applies when a
+    universe mask marks the NaN cell as PRESENT."""
+    returns, cap, invest, signal = make_market(rng, nan_frac=0.0)
+    signal = signal.copy()
+    signal[6, 2] = np.nan  # one absent name on an otherwise-normal day
+
+    s_none = settings_for(returns, cap, invest, method="mvo_turnover",
+                          max_weight=0.4, lookback_period=6)
+    out_none = run_simulation(jnp.array(signal), s_none)
+    # no forced fallback: day 6 solved normally and the NaN name never trades
+    assert bool(out_none.diagnostics.solver_ok[6])
+    assert float(np.nan_to_num(np.asarray(out_none.weights))[7, 2]) == 0.0
+
+    # the same panel WITH a universe mask marking the NaN cell present must
+    # keep the reference's rejection semantics: day 6 falls back (ok=False)
+    s_uni = settings_for(returns, cap, invest, method="mvo_turnover",
+                         max_weight=0.4, lookback_period=6,
+                         universe=jnp.ones((D, N), bool))
+    out_uni = run_simulation(jnp.array(signal), s_uni)
+    assert not bool(out_uni.diagnostics.solver_ok[6])
+
+
+def test_polish_diagnostics_surface(rng):
+    """qp_polish telemetry: accept-rate and pre/post residuals flow through
+    SolverDiagnostics and polish_stats; qp_polish=False zeroes them; the
+    deterministic schemes report no polish at all."""
+    from factormodeling_tpu.backtest import polish_stats
+
+    returns, cap, invest, signal = make_market(rng, nan_frac=0.0)
+    s_on = settings_for(returns, cap, invest, method="mvo_turnover",
+                        max_weight=0.4, lookback_period=6)
+    out_on = run_simulation(jnp.array(signal), s_on)
+    stats = polish_stats(out_on.diagnostics)
+    assert stats["attempted"] > 0
+    assert stats["accepted"] > 0
+    assert 0.0 <= stats["accept_rate"] <= 1.0
+    # accepted days must show the residual the polish achieved
+    acc = np.asarray(out_on.diagnostics.polished, bool)
+    post = np.asarray(out_on.diagnostics.polish_post_residual)
+    pre = np.asarray(out_on.diagnostics.polish_pre_residual)
+    assert (post[acc] <= pre[acc] + 1e-6).all()
+
+    s_off = settings_for(returns, cap, invest, method="mvo_turnover",
+                         max_weight=0.4, lookback_period=6, qp_polish=False)
+    out_off = run_simulation(jnp.array(signal), s_off)
+    stats_off = polish_stats(out_off.diagnostics)
+    assert stats_off["attempted"] == 0 and stats_off["accepted"] == 0
+
+    s_eq = settings_for(returns, cap, invest, method="equal")
+    out_eq = run_simulation(jnp.array(signal), s_eq)
+    assert polish_stats(out_eq.diagnostics)["attempted"] == 0

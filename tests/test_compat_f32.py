@@ -1,0 +1,102 @@
+"""Production-float32 compat coverage (round-5 advisor, medium).
+
+``compat._convert.densify`` follows the jax x64 flag: in production (x64
+off) every compat op runs float32 end to end, but the whole test suite
+enables x64 in conftest, so the f32 branch every production user hits had
+ZERO oracle coverage — a dtype/precision regression there would ship
+silently.
+
+These tests run the compat layer in a SUBPROCESS with x64 never enabled
+(the in-process jax config is already frozen to x64 by conftest; a child
+interpreter is the only clean way to exercise the production
+configuration), compare against float64 pandas oracles computed in the same
+child, and assert both the values (wider f32 tolerances) and the dtype
+contract (f32 in flight, realigned onto the caller's index).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+_CHILD = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+sys.path.insert(0, {repo!r})
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax.config.jax_enable_x64, "child must run the production f32 path"
+
+import numpy as np
+import pandas as pd
+
+from factormodeling_tpu.compat import operations as ops
+from factormodeling_tpu.compat import portfolio_simulation as compat_sim
+from tests import pandas_oracle as po
+
+rng = np.random.default_rng(20260802)
+d, n = 24, 13
+arr = np.round(rng.normal(size=(d, n)) * 2) / 2      # half-integer ties
+arr[rng.uniform(size=arr.shape) < 0.12] = np.nan
+universe = rng.uniform(size=arr.shape) < 0.9
+universe[0, :] = True
+universe[:, 0] = True
+x = po.dense_to_long(arr, universe)
+
+checks = [
+    ("ts_mean", ops.ts_mean(x, 5), po.o_ts_mean(x, 5), 1e-5),
+    ("ts_zscore", ops.ts_zscore(x, 5), po.o_ts_zscore(x, 5), 1e-4),
+    ("ts_rank", ops.ts_rank(x, 5), po.o_ts_rank(x, 5), 1e-5),
+    ("cs_rank", ops.cs_rank(x), po.o_cs_rank(x), 1e-6),
+    ("cs_zscore", ops.cs_zscore(x), po.o_cs_zscore(x), 1e-4),
+    ("market_neutralize", ops.market_neutralize(x),
+     po.o_market_neutralize(x), 1e-4),
+]
+for name, got, exp, atol in checks:
+    assert got.dtype == np.float32, (name, got.dtype)
+    assert got.index.equals(x.index), name
+    g = got.to_numpy(float)
+    e = exp.to_numpy(float)
+    if not np.allclose(np.nan_to_num(g), np.nan_to_num(e), atol=atol):
+        worst = np.nanmax(np.abs(np.nan_to_num(g) - np.nan_to_num(e)))
+        raise AssertionError(f"{{name}}: f32 compat diverged, worst {{worst}}")
+    if not (np.isnan(g) == np.isnan(e)).all():
+        raise AssertionError(f"{{name}}: NaN pattern differs in f32")
+
+# end-to-end f32 Simulation: the QP turnover scheme must keep the leg-sum
+# invariant and produce finite results in the production precision
+rets = po.dense_to_long(rng.normal(scale=0.02, size=(d, n)))
+cap = po.dense_to_long(np.ones((d, n)))
+inv = po.dense_to_long(np.ones((d, n)))
+sig = po.dense_to_long(rng.normal(size=(d, n)))
+st = compat_sim.SimulationSettings(
+    returns=rets, cap_flag=cap, investability_flag=inv,
+    factors_df=pd.DataFrame(index=sig.index), method="mvo_turnover",
+    max_weight=0.4, lookback_period=6, plot=False, output_returns=True)
+sim = compat_sim.Simulation("f32", sig, st)
+result = sim.run()
+lr = result["log_return"].to_numpy(float)
+assert np.isfinite(np.nansum(lr)), "non-finite f32 backtest P&L"
+w, counts = sim._daily_trade_list()
+wd = po.long_to_dense(w, d, n)
+live = ~np.isnan(wd).all(axis=1)
+live[:8] = False  # warmup/no-history ladder days
+longs = np.where(np.nan_to_num(wd) > 0, np.nan_to_num(wd), 0).sum(axis=1)[live]
+assert (np.abs(longs - 1.0) < 1e-2).all(), "f32 leg sums drifted"
+print("OK")
+"""
+
+
+def test_compat_f32_differential_subprocess():
+    env = dict(os.environ)
+    env.pop("JAX_ENABLE_X64", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=str(REPO))],
+        capture_output=True, text=True, timeout=420, env=env, cwd=str(REPO))
+    assert proc.returncode == 0, (
+        f"f32 compat differential failed:\n{proc.stdout}\n{proc.stderr}")
+    assert "OK" in proc.stdout

@@ -92,3 +92,45 @@ def test_compat_simulation_risk_model_covariance(rng):
         risk_refit_every=8)
     out = Simulation("rm", sig.rename("custom_feature"), settings).run()
     assert np.isfinite(out["log_return"].to_numpy(dtype=float)).all()
+
+
+def test_masked_signal_cache_survives_consumer_mutation(rng):
+    """Round-5 advisor (low): ``run()`` assigns the cached
+    signal*investability product to ``self.custom_feature``; one consumer
+    mutating it in place must NOT corrupt the value served to a later
+    Simulation over the same inputs (the cache key tracks the INPUTS'
+    backing arrays, not the cached product's)."""
+    import numpy as np
+    import pandas as pd
+
+    from factormodeling_tpu.compat.portfolio_simulation import (
+        Simulation,
+        SimulationSettings,
+    )
+    from tests import pandas_oracle as po
+
+    d, n = 16, 8
+    rets = po.dense_to_long(rng.normal(scale=0.02, size=(d, n)))
+    cap = po.dense_to_long(np.ones((d, n)))
+    inv = po.dense_to_long(np.ones((d, n)))
+    sig = po.dense_to_long(rng.normal(size=(d, n))).rename("f")
+
+    def settings():
+        return SimulationSettings(
+            returns=rets, cap_flag=cap, investability_flag=inv,
+            factors_df=None, method="equal", plot=False, output_returns=True)
+
+    sim1 = Simulation("a", sig, settings())
+    out1 = sim1.run()
+    # consumer vandalism: in-place write through the served product
+    sim1.custom_feature.iloc[:] = 123.0
+    sim2 = Simulation("b", sig, settings())
+    out2 = sim2.run()
+    # the second sim must see the pristine product, not the mutation
+    assert not np.allclose(sim2.custom_feature.to_numpy(float), 123.0,
+                           equal_nan=True)
+    np.testing.assert_allclose(
+        np.nan_to_num(out1["log_return"].to_numpy(float)),
+        np.nan_to_num(out2["log_return"].to_numpy(float)), atol=0, rtol=0)
+    # and the mutation stays visible to the consumer that made it
+    assert (sim1.custom_feature.to_numpy(float) == 123.0).all()

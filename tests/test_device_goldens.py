@@ -21,12 +21,13 @@ pytestmark = pytest.mark.skipif(
 
 def test_pipeline_f32_matches_device_pin(tmp_path):
     import jax
+    import jax.experimental
 
     import sys
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     from tools.device_goldens import check, fingerprint
 
-    with jax.enable_x64(False):
+    with jax.experimental.enable_x64(False):
         fp = fingerprint(workdir=tmp_path)
 
     pin = json.loads(PIN_PATH.read_text())

@@ -1,9 +1,11 @@
 """f32-mode semantics: the conftest enables x64 for tight oracle parity,
 but the TPU fast path executes float32 — precision-dependent rules must
 hold there too. These tests run the critical kernels under
-``jax.enable_x64(False)`` (per-call scope)."""
+``jax.experimental.enable_x64(False)`` (per-call scope; the
+unprefixed ``jax.enable_x64`` alias was removed in jax 0.4.36)."""
 
 import jax
+import jax.experimental
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
@@ -15,7 +17,7 @@ def test_constant_window_std_is_exact_zero_in_f32():
     """The constant-window detector must fire in f32 at any magnitude —
     raw-moment roundoff is ~eps*scale^2 and eps_f32 is 1e-7, so without the
     detector a 1e3-scale constant window would report std ~1e-2."""
-    with jax.enable_x64(False):
+    with jax.experimental.enable_x64(False):
         for scale in (1.0, 1e3, 1e-3):
             x = jnp.full((8, 2), jnp.float32(1.5 * scale))
             x = x.at[0, 1].set(2.0 * scale)
@@ -30,7 +32,7 @@ def test_constant_window_std_is_exact_zero_in_f32():
 
 def test_cs_rank_ties_exact_in_f32(rng):
     """Average-tie ranks are count arithmetic — exact in f32."""
-    with jax.enable_x64(False):
+    with jax.experimental.enable_x64(False):
         x_np = (np.round(rng.normal(size=(12, 9)) * 2) / 2).astype(np.float32)
         x_np[rng.uniform(size=x_np.shape) < 0.15] = np.nan
         got = np.asarray(ops.cs_rank(jnp.asarray(x_np)))
@@ -48,7 +50,7 @@ def test_mvo_turnover_legs_hold_in_f32(rng):
     solver tolerance on accepted days."""
     from factormodeling_tpu.backtest import SimulationSettings, run_simulation
 
-    with jax.enable_x64(False):
+    with jax.experimental.enable_x64(False):
         d, n = 50, 40
         returns = rng.normal(scale=0.02, size=(d, n)).astype(np.float32)
         signal = rng.normal(size=(d, n)).astype(np.float32)
@@ -81,7 +83,7 @@ def test_rolling_decay_rank_close_to_oracle_in_f32(rng):
     agreement (the bench's TPU parity bar)."""
     from tests import pandas_oracle as po
 
-    with jax.enable_x64(False):
+    with jax.experimental.enable_x64(False):
         x_np = rng.normal(size=(120, 6)).astype(np.float32)
         x_np[rng.uniform(size=x_np.shape) < 0.05] = np.nan
         w = 20

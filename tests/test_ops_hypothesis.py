@@ -14,6 +14,9 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # optional test dep; absent in slim images
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 

@@ -18,6 +18,7 @@ import os
 import numpy as np
 import pandas as pd
 import pytest
+pytest.importorskip("hypothesis")  # optional test dep; absent in slim images
 from hypothesis import HealthCheck, assume, given, settings
 from hypothesis import strategies as st
 

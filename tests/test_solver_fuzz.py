@@ -1,0 +1,202 @@
+"""Solver-level differential fuzz: random box-QP/L1 instances, the batched
+ADMM (with and without the active-set polish) vs the OSQP-algorithm
+reference implementation (``tools/osqp_reference.osqp_solve``) DIRECTLY —
+no backtest plumbing in between.
+
+Round-5 verdict #4a: the collapse of the reference's two solver families
+into one device ADMM rested on backtest-level differentials only; this file
+is the missing solver-level evidence, and doubles as the regression harness
+for the polish guard (an ACCEPTED polish must never be worse than the
+unpolished iterate it replaced — checked on every drawn instance).
+
+Instances are hypothesis-drawn when hypothesis is installed; otherwise the
+same generator runs over a fixed seed sweep so CI keeps the coverage in
+slim images (hypothesis is an optional test dep). Each instance guarantees
+primal feasibility by construction (``b = E x0`` for an in-box ``x0``).
+
+Acceptance is OBJECTIVE-level with tiers: the L1 problems are flat near the
+optimum, so two exact solvers legitimately differ in the argmin while
+agreeing in value.
+
+- tier 1 (high budget + polish): relative objective gap <= 1e-6;
+- tier 2 (default-ish cold budget + polish): <= 1e-3;
+- tier 3 (default-ish cold budget, no polish): <= 2e-2 — the documented
+  finite-budget band the polish exists to close.
+"""
+
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from factormodeling_tpu.solvers import (  # noqa: E402
+    BoxQPProblem,
+    admm_solve_dense,
+    admm_solve_lowrank,
+)
+from tools.osqp_reference import osqp_solve  # noqa: E402
+
+try:
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:
+    HAVE_HYPOTHESIS = False
+
+SEED_SWEEP = list(range(10))  # CI depth; hypothesis soaks go deeper
+
+
+def draw_instance(seed):
+    """One random box-QP/L1 instance in both ADMM and OSQP forms."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(6, 16))
+    t = int(rng.integers(3, 8))
+    k = int(rng.integers(1, 3))
+
+    V = rng.normal(scale=rng.uniform(0.01, 1.0), size=(t, n))
+    alpha = float(rng.uniform(1e-6, 1e-2))
+    s_vec = np.full(t, rng.uniform(0.01, 1.0))
+    Pfull = alpha * np.eye(n) + V.T @ (s_vec[:, None] * V)
+
+    width = rng.uniform(0.05, 1.0, size=n)
+    lo = rng.uniform(-1.0, 0.5, size=n)
+    hi = lo + width
+    pin = rng.uniform(size=n) < 0.2
+    hi[pin] = lo[pin]
+
+    E = rng.choice([0.0, 1.0], size=(k, n), p=[0.4, 0.6])
+    E[0, 0] = 1.0  # no all-zero rows
+    x0 = rng.uniform(lo, hi)
+    b = E @ x0  # feasible by construction
+
+    q = rng.normal(scale=rng.uniform(1e-4, 0.1), size=n)
+    has_l1 = bool(rng.uniform() < 0.6)
+    l1 = float(rng.uniform(0.01, 0.3)) if has_l1 else 0.0
+    center = np.where(rng.uniform(size=n) < 0.7, rng.uniform(lo, hi),
+                      rng.uniform(lo - 0.2, hi + 0.2))
+    if not has_l1:
+        center = np.zeros(n)
+    return dict(n=n, t=t, alpha=alpha, V=V, s=s_vec, P=Pfull, q=q, lo=lo,
+                hi=hi, E=E, b=b, l1=l1, center=center)
+
+
+def osqp_reference_solution(inst):
+    """Exact-optimum solve through the published-OSQP oracle: x = [w; u]
+    with u_i >= |w_i - center_i| epigraph rows when l1 > 0."""
+    n, k = inst["n"], inst["E"].shape[0]
+    m_l1 = n if inst["l1"] > 0 else 0
+    P = np.zeros((n + m_l1, n + m_l1))
+    P[:n, :n] = inst["P"]
+    q = np.concatenate([inst["q"], np.full(m_l1, inst["l1"])])
+    big = 1e30
+    rows, lo_r, hi_r = [], [], []
+    for i in range(n):  # box
+        r = np.zeros(n + m_l1)
+        r[i] = 1.0
+        rows.append(r)
+        lo_r.append(inst["lo"][i])
+        hi_r.append(inst["hi"][i])
+    for j in range(k):  # equalities
+        rows.append(np.concatenate([inst["E"][j], np.zeros(m_l1)]))
+        lo_r.append(inst["b"][j])
+        hi_r.append(inst["b"][j])
+    for i in range(m_l1):  # |w_i - c_i| epigraph
+        r1 = np.zeros(n + m_l1)
+        r1[i], r1[n + i] = 1.0, -1.0
+        rows.append(r1)
+        lo_r.append(-big)
+        hi_r.append(inst["center"][i])
+        r2 = np.zeros(n + m_l1)
+        r2[i], r2[n + i] = -1.0, -1.0
+        rows.append(r2)
+        lo_r.append(-big)
+        hi_r.append(-inst["center"][i])
+    res = osqp_solve(P, q, np.array(rows), np.array(lo_r), np.array(hi_r),
+                     max_iter=20000, eps_abs=1e-10, eps_rel=1e-10)
+    assert res.status in ("solved", "solved_inaccurate"), res.status
+    return res.x[:n]
+
+
+def objective(inst, x):
+    x = np.asarray(x, float)
+    return float(0.5 * x @ inst["P"] @ x + inst["q"] @ x
+                 + inst["l1"] * np.abs(x - inst["center"]).sum())
+
+
+def feasibility(inst, x):
+    x = np.asarray(x, float)
+    box = np.maximum(np.maximum(inst["lo"] - x, x - inst["hi"]), 0.0).max()
+    eq = np.abs(inst["E"] @ x - inst["b"]).max()
+    return max(box, eq)
+
+
+def admm_solutions(inst, iters, polish):
+    prob = BoxQPProblem(jnp.asarray(inst["q"]), jnp.asarray(inst["lo"]),
+                        jnp.asarray(inst["hi"]), jnp.asarray(inst["E"]),
+                        jnp.asarray(inst["b"]), jnp.asarray(inst["l1"]),
+                        jnp.asarray(inst["center"]))
+    lr = admm_solve_lowrank(jnp.asarray(inst["alpha"]),
+                            jnp.asarray(inst["V"]), jnp.asarray(inst["s"]),
+                            prob, iters=iters, polish=polish)
+    dn = admm_solve_dense(jnp.asarray(inst["P"]), prob, iters=iters,
+                          polish=polish)
+    return lr, dn
+
+
+def check_instance(seed):
+    inst = draw_instance(seed)
+    x_ref = osqp_reference_solution(inst)
+    f_ref = objective(inst, x_ref)
+    scale = 1.0 + abs(f_ref)
+
+    # tier 1: high budget + polish reaches the oracle's optimum in value
+    hi_lr, hi_dn = admm_solutions(inst, iters=1200, polish=True)
+    for res in (hi_lr, hi_dn):
+        assert feasibility(inst, res.x) < 1e-6, seed
+        assert objective(inst, res.x) <= f_ref + 1e-6 * scale, (
+            seed, objective(inst, res.x), f_ref)
+
+    # tier 2/3: a small cold budget, with and without polish. The
+    # feasibility bound matters: objective alone is vacuous (an infeasible
+    # point can undercut the constrained optimum), so both tiers also cap
+    # the box/eq violation at the documented small-budget residual band.
+    sm_on_lr, sm_on_dn = admm_solutions(inst, iters=80, polish=True)
+    sm_off_lr, sm_off_dn = admm_solutions(inst, iters=80, polish=False)
+    for res in (sm_on_lr, sm_on_dn):
+        assert feasibility(inst, res.x) < 5e-2, seed
+        assert objective(inst, res.x) <= f_ref + 1e-3 * scale, seed
+    for res in (sm_off_lr, sm_off_dn):
+        assert feasibility(inst, res.x) < 5e-2, seed
+        assert objective(inst, res.x) <= f_ref + 2e-2 * scale, seed
+
+    # the polish guard's regression contract, on every budget: an accepted
+    # polish is never less feasible and never worse in objective than the
+    # box-projected unpolished iterate it replaced
+    for on, off in ((sm_on_lr, sm_off_lr), (sm_on_dn, sm_off_dn)):
+        if bool(on.polished):
+            assert feasibility(inst, on.x) <= feasibility(inst, off.x) + 1e-6
+            proj = np.clip(np.asarray(off.x), inst["lo"], inst["hi"])
+            assert objective(inst, on.x) <= objective(inst, proj) + 1e-4 * scale
+        else:
+            np.testing.assert_array_equal(np.asarray(on.x),
+                                          np.asarray(off.x))
+
+
+if HAVE_HYPOTHESIS:
+
+    @settings(deadline=None, max_examples=12,
+              suppress_health_check=[HealthCheck.too_slow])
+    @given(seed=st.integers(0, 2**31 - 1))
+    def test_fuzz_admm_matches_osqp_reference(seed):
+        check_instance(seed)
+
+else:
+
+    @pytest.mark.parametrize("seed", SEED_SWEEP)
+    def test_fuzz_admm_matches_osqp_reference(seed):
+        check_instance(seed)

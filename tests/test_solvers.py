@@ -194,8 +194,9 @@ def test_warm_start_accelerates_l1_convergence(rng):
     """Day-over-day warm start (``ADMMResult.warm_state`` -> ``warm_start``):
     on a perturbed L1 (turnover-style) problem, a small warm budget must land
     at least as close to the exact optimum as the same budget cold, and
-    dramatically closer than cold at the L1-flat default. Mirrors the
-    reference's persistent OSQP warm start (portfolio_simulation.py:427-437)."""
+    dramatically closer than cold at the L1-flat default — the device analog
+    of the reference's scipy-path x0 = prev_weights seeding
+    (portfolio_simulation.py:676-680)."""
     n, t = 30, 20
     R = rng.normal(0, 0.02, size=(t, n))
     C = R - R.mean(0)
