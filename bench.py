@@ -29,17 +29,27 @@ themselves are validated separately by ``tools/baseline_scaling.py``
 (committed evidence: ``BASELINE_SCALING.json``).
 
 ``--profile`` wraps the timed section of each selected config in a
-``jax.profiler`` trace (written under ``/tmp/jax-bench-trace``).
+``jax.profiler`` trace (written under ``$FMT_TRACE_DIR``, default
+``/tmp/jax-bench-trace``; every emitted row records the resolved path so a
+published number can always be matched to its trace). ``--report PATH``
+additionally writes every row — plus any stage records the library layers
+contribute — as a ``factormodeling_tpu.obs.RunReport`` JSONL, rendered by
+``tools/trace_report.py``.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 from pathlib import Path
 
 import numpy as np
+
+# profiler trace destination: FMT_TRACE_DIR overrides (a writable scratch
+# dir on shared hosts); recorded in every emitted row for provenance
+_TRACE_DIR = os.environ.get("FMT_TRACE_DIR", "/tmp/jax-bench-trace")
 
 # ----------------------------------------------------------------- helpers
 
@@ -86,6 +96,8 @@ def _time_fn(fn, *, repeats=3):
     fn()  # compile + warm up
     times = []
     for _ in range(repeats):
+        # the fence lives inside fn by contract (rule B audits call sites):
+        # timing: fenced-callable
         t0 = time.perf_counter()
         fn()
         times.append(time.perf_counter() - t0)
@@ -151,6 +163,12 @@ def _result(name, seconds, *, baseline_s=None, baseline_method=None,
         out["roofline_note"] = roofline_note
     if extras:
         out.update(extras)
+    out["trace_dir"] = _TRACE_DIR
+    # contribute the row to an active obs.RunReport (--report), where it
+    # lands next to the stage records the library layers emit
+    from factormodeling_tpu.obs import record_stage
+
+    record_stage(f"bench/{name}", kind="bench", **out)
     return out
 
 
@@ -161,7 +179,7 @@ def _profiled(profile, name):
         return contextlib.nullcontext()
     import jax
 
-    return jax.profiler.trace(f"/tmp/jax-bench-trace/{name}")
+    return jax.profiler.trace(f"{_TRACE_DIR}/{name}")
 
 
 # ------------------------------------------------- config 0: rank-IC 500x252
@@ -217,7 +235,7 @@ def bench_rank_ic(smoke=False, profile=False):
             out[t] = np.corrcoef(fr, rets[t, v])[0, 1]
         return out
 
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (pure numpy/scipy loop)
     expected = numpy_rank_ic()
     baseline_s = time.perf_counter() - t0
 
@@ -296,7 +314,7 @@ def bench_rank_ic_batched(smoke=False, profile=False):
     # single-point form: sub-ms marginal differences there are jitter and
     # could even go negative.
     def _rank_ic_loop(db):
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # timing: host-sync (numpy/scipy loop)
         for t in range(1, db + 1):
             v = ~np.isnan(factor[0, t - 1]) & ~np.isnan(rets[t])
             np.corrcoef(rankdata(factor[0, t - 1, v]), rets[t, v])
@@ -407,7 +425,7 @@ def bench_composite_ops(smoke=False, profile=False):
     idx = pd.MultiIndex.from_product([range(d), range(n)],
                                      names=["date", "symbol"])
     gser = pd.Series(groups.ravel(), index=idx)
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (pandas groupby chain)
     for i in range(fb):
         s = pd.Series(stack[i].ravel(), index=idx)
         z = s.groupby(level="date").transform(
@@ -484,7 +502,7 @@ def bench_cs_ols(smoke=False, profile=False):
 
     # numpy baseline: per-date lstsq loop at reduced dates, extrapolated
     db = 8 if smoke else 126
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (numpy lstsq loop)
     for t in range(db):
         v = ~np.isnan(y[t])
         a = np.stack([x[i, t, v] for i in range(f)] + [np.ones(v.sum())], 1)
@@ -572,7 +590,7 @@ def bench_risk_model(smoke=False, profile=False):
     # measures all of its (tiny) panel too — no scale-up anywhere.
     nb = n
     sub = np.nan_to_num(rets[:, :nb]).astype(np.float64)
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (numpy dual-Gram PCA)
     c = sub - sub.mean(0)
     gram = c @ c.T
     evals, evecs = np.linalg.eigh(gram)
@@ -647,7 +665,7 @@ def bench_sweep(smoke=False, profile=False):
     # asymptote (20.7 ms/date vs 20.9 at 320)
     db, fb = (16, 2) if smoke else (160, 5)
     idx_dense = factors[:fb, :db, :]
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (pandas oracle pass)
     books = []
     for i in range(fb):
         w, _ = po.o_daily_trade_list(po.dense_to_long(idx_dense[i]), "equal")
@@ -1272,6 +1290,8 @@ def bench_compat_pipeline(smoke=False, profile=False):
 
     with _profiled(profile, "compat_pipeline"):
         pair()  # compile + warm the vocab/jit caches
+        # pair() returns pandas frames, so every device value materializes:
+        # timing: host-sync
         seconds = _time_fn(pair, repeats=2 if smoke else 3)
 
     res_eq, res_lin = pair()
@@ -1355,7 +1375,7 @@ def bench_north_star_disk(smoke=False, profile=False):
 
     tmp = Path(tempfile.mkdtemp(prefix="fm_disk_bench_"))
     try:
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # timing: host-sync (disk write of numpy chunks)
         root = save_factor_stack_chunks(
             tmp / "stack", gen_chunks(),
             factor_names=[f"f{i}_flx" for i in range(f)])
@@ -1468,6 +1488,9 @@ def main() -> None:
     parser.add_argument("--profile", action="store_true")
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend (skip the TPU relay)")
+    parser.add_argument("--report", default=None, metavar="PATH",
+                        help="write an obs.RunReport JSONL (bench rows + "
+                             "library stage records) to PATH")
     args = parser.parse_args()
     if args.cpu:
         import jax
@@ -1486,11 +1509,24 @@ def main() -> None:
         names.sort(key=lambda n: n != "north_star")
     else:
         names = args.configs or ["mvo_turnover"]
+
+    import contextlib
+
+    from factormodeling_tpu.obs import RunReport
+
+    report = RunReport("bench", meta={"trace_dir": _TRACE_DIR})
     results = []
-    for name in names:
-        res = CONFIGS[name](smoke=args.smoke, profile=args.profile)
-        results.append(res)
-        print(json.dumps(res))
+    try:
+        with report.activate() if args.report else contextlib.nullcontext():
+            for name in names:
+                res = CONFIGS[name](smoke=args.smoke, profile=args.profile)
+                results.append(res)
+                print(json.dumps(res))
+    finally:
+        # a failing config must not discard the completed configs' rows —
+        # partial evidence is exactly what a report of a broken run is for
+        if args.report:
+            print(f"run report: {report.write_jsonl(args.report)}")
 
     if args.all and not args.smoke:
         baseline_path = Path(__file__).parent / "BASELINE.json"

@@ -98,8 +98,14 @@ def make_demo_data(data_dir: str | Path, *, n_dates=150, n_symbols=40,
 def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
                  window: int = 20, decay: int = 10, pct: float = 0.2,
                  max_weight: float = 0.5, qp_iters: int = 500,
-                 verbose: bool = True) -> dict:
-    """The full reference workflow; returns a dict of stage outputs."""
+                 verbose: bool = True, report_path=None) -> dict:
+    """The full reference workflow; returns a dict of stage outputs.
+
+    ``report_path`` turns on the observability layer: the run executes under
+    an active :class:`factormodeling_tpu.obs.RunReport` (stage spans here,
+    device counters + cost estimates contributed by the compat
+    ``Simulation`` layer) and the merged JSONL is written to the path —
+    render it with ``python tools/trace_report.py <path>``."""
     from factormodeling_tpu.compat.composite_factor import (
         composite_factor_calculation,
         weighted_composite_factor,
@@ -116,137 +122,160 @@ def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
         SimulationSettings,
     )
     from factormodeling_tpu.io import ArtifactStore
+    from factormodeling_tpu import obs
 
     data_dir = Path(data_dir)
     store = ArtifactStore(artifact_dir)
     say = print if verbose else (lambda *a, **k: None)
 
-    # ---- 1. load (cells 4-5)
-    features_df = pd.read_csv(data_dir / FEATURES_CSV)
-    features_df["date"] = pd.to_datetime(features_df["date"])
-    features_df = features_df.set_index(["date", "symbol"])
-    factors_df = pd.read_csv(data_dir / FACTORS_CSV)
-    factors_df["date"] = pd.to_datetime(factors_df["date"])
-    factors_df = factors_df.set_index(["date", "symbol"])
-    single_factor_returns = pd.read_csv(data_dir / FACTOR_RETURNS_CSV)
-    single_factor_returns["date"] = pd.to_datetime(single_factor_returns["date"])
-    single_factor_returns = single_factor_returns.set_index("date")
+    import contextlib
 
-    returns = features_df["log_return"]
-    cap_flag = features_df["cap_flag"]
-    investability_flag = features_df["investability_flag"]
-    com_factors_df = pd.DataFrame(index=factors_df.index)
-    SimSettings = partial(
-        SimulationSettings, returns=returns, cap_flag=cap_flag,
-        investability_flag=investability_flag, factors_df=com_factors_df,
-        method="equal", transaction_cost=True, max_weight=max_weight,
-        pct=pct, plot=False, output_returns=True, qp_iters=qp_iters)
+    report = obs.RunReport("examples/pipeline",
+                           meta={"window": window, "decay": decay})
+    # activate only when a report was requested: an active report makes the
+    # compat sims contribute counters AND (cached per signature) cost-
+    # analysis lowerings, which the plain pipeline should not pay for
+    activation = report.activate() if report_path is not None \
+        else contextlib.nullcontext()
 
-    def simulate(name, feature, **overrides):
-        sim = Simulation(name, feature.rename("custom_feature"),
-                         SimSettings(**overrides))
-        result = sim.run()
-        summary = PortfolioAnalyzer(result).summary()
-        say(f"  {name}: " + ", ".join(
-            f"{k}={v}" for k, v in summary.items()
+    with activation:
+        # ---- 1. load (cells 4-5)
+        features_df = pd.read_csv(data_dir / FEATURES_CSV)
+        features_df["date"] = pd.to_datetime(features_df["date"])
+        features_df = features_df.set_index(["date", "symbol"])
+        factors_df = pd.read_csv(data_dir / FACTORS_CSV)
+        factors_df["date"] = pd.to_datetime(factors_df["date"])
+        factors_df = factors_df.set_index(["date", "symbol"])
+        single_factor_returns = pd.read_csv(data_dir / FACTOR_RETURNS_CSV)
+        single_factor_returns["date"] = pd.to_datetime(single_factor_returns["date"])
+        single_factor_returns = single_factor_returns.set_index("date")
+
+        returns = features_df["log_return"]
+        cap_flag = features_df["cap_flag"]
+        investability_flag = features_df["investability_flag"]
+        com_factors_df = pd.DataFrame(index=factors_df.index)
+        SimSettings = partial(
+            SimulationSettings, returns=returns, cap_flag=cap_flag,
+            investability_flag=investability_flag, factors_df=com_factors_df,
+            method="equal", transaction_cost=True, max_weight=max_weight,
+            pct=pct, plot=False, output_returns=True, qp_iters=qp_iters)
+
+        def simulate(name, feature, **overrides):
+            sim = Simulation(name, feature.rename("custom_feature"),
+                             SimSettings(**overrides))
+            result = sim.run()
+            summary = PortfolioAnalyzer(result).summary()
+            say(f"  {name}: " + ", ".join(
+                f"{k}={v}" for k, v in summary.items()
+                if k in ("Annualized Return", "Sharpe Ratio", "Maximum Drawdown")))
+            return result, summary
+
+        out: dict = {}
+
+        # ---- 2. full-sample metrics (cell 8)
+        say("=== Factor analysis metrics ===")
+        with report.span("pipeline/factor_metrics", sync="host"):
+            metrics = single_factor_metrics(factors_df, returns)
+        store.save_frame("10.factor_analysis_metrics", metrics)
+        say(metrics.round(4).to_string())
+        out["metrics"] = metrics
+
+        # ---- 3. static composites + decay + equal/linear sims (cells 10-18)
+        say("=== Static composites ===")
+        all_names = list(factors_df.columns)
+        results: dict = {}
+        for method in ("zscore", "rank"):
+            comp = composite_factor_calculation(factors_df, all_names, method=method)
+            com_factors_df[f"static_{method}"] = comp
+            decayed = ts_decay(comp, decay)
+            results[f"static_{method}_equal"] = simulate(
+                f"static_{method}_d{decay}_equal", decayed)
+            results[f"static_{method}_linear"] = simulate(
+                f"static_{method}_d{decay}_linear", decayed, method="linear",
+                max_weight=0.1)
+
+        # ---- 3b. decay-window sensitivity (cells 6/14/18)
+        say("=== Decay sensitivity (static_zscore) ===")
+        from factormodeling_tpu.compat.decay import decay_sensitivity
+
+        sens = decay_sensitivity(com_factors_df["static_zscore"], SimSettings(),
+                                 decay_period=[1, 5, decay, 2 * decay])
+        say(sens.round(4).to_string())
+        out["decay_sensitivity"] = sens
+
+        # ---- 4. rolling selection (cells 21-23)
+        say("=== Rolling factor selection ===")
+        selector_specs = {
+            "icir": ("icir_top", {"top_x": 3, "icir_threshold": -1}),
+            "momentum": ("momentum", {"max_weight": 0.3}),
+            "mvo": ("mvo", {"max_weight": 0.3, "turnover_penalty": 0.5}),
+            # native extensions beyond the reference registry (north-star
+            # "PCA/regression blend")
+            "pca": ("pca", {}),
+            "regression": ("regression", {"ridge": 1e-3}),
+        }
+        factor_weights: dict = {}
+        for label, (method, kwargs) in selector_specs.items():
+            selector = FactorSelector(
+                factors_df=factors_df, returns=returns,
+                factor_ret_df=single_factor_returns, window=window,
+                method=method, method_kwargs=kwargs)
+            with report.span(f"pipeline/selection/{label}", sync="host"):
+                fw = selector.prepare_selection()
+            store.save_frame(f"factor_weights/factor_weights_{label}", fw)
+            say(f"  {label}: avg non-zero weights/day = "
+                f"{(fw > 0).sum(axis=1).mean():.2f}")
+            factor_weights[label] = fw
+        out["factor_weights"] = factor_weights
+
+        # ---- 5. weighted composites (cells 25-26)
+        say("=== Weighted composites ===")
+        composites: dict = {}
+        for label, fw in factor_weights.items():
+            with report.span(f"pipeline/composite/{label}", sync="host"):
+                comp = weighted_composite_factor(factors_df, fw,
+                                                 method="zscore")
+            store.save_frame(f"composite_factors/composite_factor_{label}_zscore",
+                             comp.to_frame("composite"))
+            com_factors_df[f"{label}_zscore"] = comp
+            composites[label] = comp
+        out["composites"] = composites
+
+        # ---- 6. per-composite sims across the 4 schemes (cells 30-49)
+        say("=== Simulations across weight schemes ===")
+        for label, comp in composites.items():
+            decayed = ts_decay(comp, decay)
+            for scheme, overrides in [
+                ("equal", {}),
+                ("linear", {"method": "linear", "max_weight": 0.1}),
+                ("mvo", {"method": "mvo"}),
+                ("mvo_turnover", {"method": "mvo_turnover",
+                                  "turnover_penalty": 0.1}),
+            ]:
+                results[f"{label}_{scheme}"] = simulate(
+                    f"{label}_d{decay}_{scheme}", decayed, **overrides)
+        out["results"] = results
+
+        # ---- 7. multi-manager (cells 53-56)
+        say("=== Multi-manager backtest ===")
+        mm_settings = SimSettings()
+        with report.span("pipeline/multimanager", sync="host"):
+            mm_result, top_longs, top_shorts, mm_counts = \
+                run_multimanager_backtest(
+                    factors_df, returns, cap_flag, factor_weights["momentum"],
+                    mm_settings)
+        mm_summary = PortfolioAnalyzer(mm_result).summary()
+        store.save_frame("multimanager_result", mm_result.set_index("date"))
+        say("  multimanager: " + ", ".join(
+            f"{k}={v}" for k, v in mm_summary.items()
             if k in ("Annualized Return", "Sharpe Ratio", "Maximum Drawdown")))
-        return result, summary
+        out["multimanager"] = (mm_result, mm_summary, mm_counts)
 
-    out: dict = {}
-
-    # ---- 2. full-sample metrics (cell 8)
-    say("=== Factor analysis metrics ===")
-    metrics = single_factor_metrics(factors_df, returns)
-    store.save_frame("10.factor_analysis_metrics", metrics)
-    say(metrics.round(4).to_string())
-    out["metrics"] = metrics
-
-    # ---- 3. static composites + decay + equal/linear sims (cells 10-18)
-    say("=== Static composites ===")
-    all_names = list(factors_df.columns)
-    results: dict = {}
-    for method in ("zscore", "rank"):
-        comp = composite_factor_calculation(factors_df, all_names, method=method)
-        com_factors_df[f"static_{method}"] = comp
-        decayed = ts_decay(comp, decay)
-        results[f"static_{method}_equal"] = simulate(
-            f"static_{method}_d{decay}_equal", decayed)
-        results[f"static_{method}_linear"] = simulate(
-            f"static_{method}_d{decay}_linear", decayed, method="linear",
-            max_weight=0.1)
-
-    # ---- 3b. decay-window sensitivity (cells 6/14/18)
-    say("=== Decay sensitivity (static_zscore) ===")
-    from factormodeling_tpu.compat.decay import decay_sensitivity
-
-    sens = decay_sensitivity(com_factors_df["static_zscore"], SimSettings(),
-                             decay_period=[1, 5, decay, 2 * decay])
-    say(sens.round(4).to_string())
-    out["decay_sensitivity"] = sens
-
-    # ---- 4. rolling selection (cells 21-23)
-    say("=== Rolling factor selection ===")
-    selector_specs = {
-        "icir": ("icir_top", {"top_x": 3, "icir_threshold": -1}),
-        "momentum": ("momentum", {"max_weight": 0.3}),
-        "mvo": ("mvo", {"max_weight": 0.3, "turnover_penalty": 0.5}),
-        # native extensions beyond the reference registry (north-star
-        # "PCA/regression blend")
-        "pca": ("pca", {}),
-        "regression": ("regression", {"ridge": 1e-3}),
-    }
-    factor_weights: dict = {}
-    for label, (method, kwargs) in selector_specs.items():
-        selector = FactorSelector(
-            factors_df=factors_df, returns=returns,
-            factor_ret_df=single_factor_returns, window=window,
-            method=method, method_kwargs=kwargs)
-        fw = selector.prepare_selection()
-        store.save_frame(f"factor_weights/factor_weights_{label}", fw)
-        say(f"  {label}: avg non-zero weights/day = "
-            f"{(fw > 0).sum(axis=1).mean():.2f}")
-        factor_weights[label] = fw
-    out["factor_weights"] = factor_weights
-
-    # ---- 5. weighted composites (cells 25-26)
-    say("=== Weighted composites ===")
-    composites: dict = {}
-    for label, fw in factor_weights.items():
-        comp = weighted_composite_factor(factors_df, fw, method="zscore")
-        store.save_frame(f"composite_factors/composite_factor_{label}_zscore",
-                         comp.to_frame("composite"))
-        com_factors_df[f"{label}_zscore"] = comp
-        composites[label] = comp
-    out["composites"] = composites
-
-    # ---- 6. per-composite sims across the 4 schemes (cells 30-49)
-    say("=== Simulations across weight schemes ===")
-    for label, comp in composites.items():
-        decayed = ts_decay(comp, decay)
-        for scheme, overrides in [
-            ("equal", {}),
-            ("linear", {"method": "linear", "max_weight": 0.1}),
-            ("mvo", {"method": "mvo"}),
-            ("mvo_turnover", {"method": "mvo_turnover",
-                              "turnover_penalty": 0.1}),
-        ]:
-            results[f"{label}_{scheme}"] = simulate(
-                f"{label}_d{decay}_{scheme}", decayed, **overrides)
-    out["results"] = results
-
-    # ---- 7. multi-manager (cells 53-56)
-    say("=== Multi-manager backtest ===")
-    mm_settings = SimSettings()
-    mm_result, top_longs, top_shorts, mm_counts = run_multimanager_backtest(
-        factors_df, returns, cap_flag, factor_weights["momentum"], mm_settings)
-    mm_summary = PortfolioAnalyzer(mm_result).summary()
-    store.save_frame("multimanager_result", mm_result.set_index("date"))
-    say("  multimanager: " + ", ".join(
-        f"{k}={v}" for k, v in mm_summary.items()
-        if k in ("Annualized Return", "Sharpe Ratio", "Maximum Drawdown")))
-    out["multimanager"] = (mm_result, mm_summary, mm_counts)
-
-    store.save_frame("com_factors_df", com_factors_df)  # cell 50
+        store.save_frame("com_factors_df", com_factors_df)  # cell 50
+    if report_path is not None:
+        path = report.write_jsonl(report_path)
+        say(f"run report: {path} "
+            f"(render: python tools/trace_report.py {path})")
     return out
 
 
@@ -260,6 +289,10 @@ def main() -> None:
     parser.add_argument("--decay", type=int, default=10)
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend (skip the TPU relay)")
+    parser.add_argument("--report", default=None, metavar="PATH",
+                        help="write the run's observability JSONL "
+                             "(obs.RunReport) to PATH; render with "
+                             "tools/trace_report.py")
     args = parser.parse_args()
     _force_cpu_if_requested(args.cpu)
 
@@ -267,7 +300,7 @@ def main() -> None:
         args.data = make_demo_data("data/demo")
         print(f"synthesized demo data in {args.data}")
     run_pipeline(args.data, args.artifacts, window=args.window,
-                 decay=args.decay)
+                 decay=args.decay, report_path=args.report)
     print("pipeline complete; artifacts in", args.artifacts)
 
 

@@ -58,26 +58,37 @@ def polish_stats(diag: SolverDiagnostics) -> dict:
     ``attempted`` counts days where a polish candidate was evaluated
     (pre-residual is finite); ``accept_rate`` is accepted / attempted (NaN
     when nothing was attempted). Residual aggregates are over attempted
-    days only, so they describe what the polish saw, not the ladder."""
+    days with a finite value — an all-rejected polish whose candidates went
+    non-finite reports NaN post aggregates rather than raising numpy's
+    all-NaN-slice ``RuntimeWarning`` (zero-day diagnostics likewise: every
+    field NaN/0, warning-free)."""
     pre = np.asarray(diag.polish_pre_residual, float)
     post = np.asarray(diag.polish_post_residual, float)
     accepted = np.asarray(diag.polished, bool)
     tried = np.isfinite(pre)
     n_tried = int(tried.sum())
-    with np.errstate(invalid="ignore"):
-        return {
-            "attempted": n_tried,
-            "accepted": int(accepted.sum()),
-            "accept_rate": (float(accepted.sum() / n_tried) if n_tried
-                            else float("nan")),
-            "pre_residual_mean": float(np.nanmean(pre)) if n_tried else float("nan"),
-            "pre_residual_p99": (float(np.nanpercentile(pre, 99)) if n_tried
-                                 else float("nan")),
-            "post_residual_mean": (float(np.nanmean(post)) if n_tried
-                                   else float("nan")),
-            "post_residual_p99": (float(np.nanpercentile(post, 99)) if n_tried
-                                  else float("nan")),
-        }
+
+    def _agg(a):
+        # mean/p99 over the finite entries; empty -> NaN with no numpy
+        # empty-slice / all-NaN warning (the degenerate inputs this guards:
+        # D=0 runs, polish disabled, every candidate non-finite)
+        a = a[np.isfinite(a)]
+        if a.size == 0:
+            return float("nan"), float("nan")
+        return float(a.mean()), float(np.percentile(a, 99))
+
+    pre_mean, pre_p99 = _agg(pre[tried])
+    post_mean, post_p99 = _agg(post[tried])
+    return {
+        "attempted": n_tried,
+        "accepted": int(accepted.sum()),
+        "accept_rate": (float(accepted.sum() / n_tried) if n_tried
+                        else float("nan")),
+        "pre_residual_mean": pre_mean,
+        "pre_residual_p99": pre_p99,
+        "post_residual_mean": post_mean,
+        "post_residual_p99": post_p99,
+    }
 
 
 def check_anomalies(diag: SolverDiagnostics, *, name: str = "simulation",

@@ -24,6 +24,7 @@ from factormodeling_tpu.backtest.mvo import mvo_turnover_weights, mvo_weights
 from factormodeling_tpu.backtest.pnl import DailyResult, daily_portfolio_returns
 from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.backtest.weights import equal_weights, linear_weights
+from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.ops._window import masked_shift, shift
 
 __all__ = ["SimulationOutput", "daily_trade_list", "run_simulation"]
@@ -48,16 +49,17 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
     nan_d = jnp.full((d,), jnp.nan, signal.dtype)
     ok_d = jnp.ones((d,), bool)
     no_polish = (jnp.zeros((d,), bool), nan_d, nan_d)
-    if s.method == "equal":
-        (w, lc, sc), resid, ok = equal_weights(signal, s.pct), nan_d, ok_d
-        polish = no_polish
-    elif s.method == "linear":
-        (w, lc, sc), resid, ok = linear_weights(signal, s.max_weight), nan_d, ok_d
-        polish = no_polish
-    elif s.method == "mvo":
-        w, lc, sc, resid, ok, polish = mvo_weights(signal, s)
-    else:  # mvo_turnover
-        w, lc, sc, resid, ok, polish = mvo_turnover_weights(signal, s)
+    with obs_stage(f"backtest/trade_list/{s.method}"):
+        if s.method == "equal":
+            (w, lc, sc), resid, ok = equal_weights(signal, s.pct), nan_d, ok_d
+            polish = no_polish
+        elif s.method == "linear":
+            (w, lc, sc), resid, ok = linear_weights(signal, s.max_weight), nan_d, ok_d
+            polish = no_polish
+        elif s.method == "mvo":
+            w, lc, sc, resid, ok, polish = mvo_weights(signal, s)
+        else:  # mvo_turnover
+            w, lc, sc, resid, ok, polish = mvo_turnover_weights(signal, s)
 
     diag = SolverDiagnostics(
         primal_residual=resid, solver_ok=ok,
@@ -80,6 +82,7 @@ def run_simulation(signal: jnp.ndarray, s: SimulationSettings) -> SimulationOutp
     :mod:`factormodeling_tpu.analytics`)."""
     masked = signal * s.investability_flag
     weights, lc, sc, diag = daily_trade_list(masked, s)
-    result = daily_portfolio_returns(weights, s)
+    with obs_stage("backtest/pnl"):
+        result = daily_portfolio_returns(weights, s)
     return SimulationOutput(weights=weights, long_count=lc, short_count=sc,
                             result=result, diagnostics=diag)

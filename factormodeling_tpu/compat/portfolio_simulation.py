@@ -26,11 +26,13 @@ from factormodeling_tpu.backtest import (
     daily_trade_list as _dense_trade_list,
 )
 from factormodeling_tpu.backtest.diagnostics import (SolverDiagnostics,
-                                                     check_anomalies)
+                                                     check_anomalies,
+                                                     polish_stats)
 from factormodeling_tpu.backtest.pnl import daily_portfolio_returns as _dense_pnl
 from factormodeling_tpu.backtest.pnl import signal_metrics as _dense_signal_metrics
 from factormodeling_tpu.compat._convert import (PanelVocab, _IdentityCache,
                                                 level_values)
+from factormodeling_tpu.obs import active_report, cost_estimate
 
 __all__ = ["SimulationSettings", "Simulation"]
 
@@ -91,6 +93,45 @@ def _device_panel(vocab: PanelVocab, series: pd.Series) -> jnp.ndarray:
 # profiling). Settings statics are hashable, so one jit per (method, knobs).
 _jit_trade_list = jax.jit(_dense_trade_list)
 _jit_pnl = jax.jit(_dense_pnl)
+
+# cost-analysis estimates for the fused run, cached per abstract signature:
+# lowering retraces, so an active RunReport must not pay it per Simulation —
+# the cell-39 pattern runs many sims over identical shapes/methods. The key
+# is the settings pytree STRUCTURE (every static knob — method, lookback,
+# qp/risk config — lives in the treedef aux) plus the signal's shape/dtype,
+# i.e. exactly jit's own dispatch signature, so two sims share a row only
+# when they would share a compilation.
+_COST_ROWS: dict[tuple, dict] = {}
+
+
+def _fused_cost(sig, uni, s, s_full) -> dict:
+    key = (jax.tree_util.tree_structure((s, s_full)),
+           tuple(sig.shape), str(sig.dtype))
+    if key not in _COST_ROWS:
+        _COST_ROWS[key] = cost_estimate(_fused_run_device, sig, uni, s,
+                                        s_full)
+    return _COST_ROWS[key]
+
+
+def _record_sim(name: str, method: str, diag: SolverDiagnostics,
+                n_anomalies: int, cost: dict | None) -> None:
+    """Contribute one Simulation's device counters (+ cached cost estimate)
+    to the active RunReport; the span row is recorded by the caller."""
+    rep = active_report()
+    if rep is None:
+        return
+    active = np.asarray(diag.active, bool)
+    ok = np.asarray(diag.solver_ok, bool)
+    rep.add_counters(f"compat/sim/{name}", {
+        "method": method,
+        "days": int(active.size),
+        "active_days": int(active.sum()),
+        "solver_fallback_days": int((active & ~ok).sum()),
+        "anomalies": n_anomalies,
+        "polish": polish_stats(diag),
+    })
+    if cost is not None:
+        rep.record(f"compat/sim/{name}", kind="cost", **cost)
 
 
 @jax.jit
@@ -308,9 +349,21 @@ class Simulation:
         sig_dev = _DEVICE_PANELS.get(
             (masked, masked._values, vocab),
             lambda: jnp.asarray(sig))
-        w, res, packed = _fused_run_device(sig_dev, s.universe, s, s_full)
+        rep = active_report()
+        if rep is not None:
+            with rep.span(f"compat/sim/{self.name}",
+                          method=self.method) as sp:
+                w, res, packed = _fused_run_device(sig_dev, s.universe, s,
+                                                   s_full)
+                sp.add(packed)
+        else:
+            w, res, packed = _fused_run_device(sig_dev, s.universe, s,
+                                               s_full)
         cols, lc, sc, diag = _unpack(np.asarray(packed))
-        check_anomalies(diag, name=self.name)
+        msgs = check_anomalies(diag, name=self.name)
+        _record_sim(self.name, self.method, diag, len(msgs),
+                    _fused_cost(sig_dev, s.universe, s, s_full)
+                    if rep is not None else None)
         counts = pd.DataFrame(
             {"long_count": lc.astype(int), "short_count": sc.astype(int)},
             index=pd.Index(self._vocab.dates, name="date"))
@@ -333,7 +386,10 @@ class Simulation:
         w, lc, sc, diag = _jit_trade_list(jnp.asarray(sig), s)
         # replay the reference's runtime warnings (portfolio_simulation.py:
         # 448-449 leg sums, :452-459 solver fallback) after the device pass
-        check_anomalies(diag, name=self.name)
+        msgs = check_anomalies(diag, name=self.name)
+        if active_report() is not None:
+            diag_host = SolverDiagnostics(*(np.asarray(a) for a in diag))
+            _record_sim(self.name, self.method, diag_host, len(msgs), None)
         weights = self._vocab.to_series(np.asarray(w), uni, name="weight")
         sig_dates = pd.Index(
             level_values(self.custom_feature.index, "date", 0).unique())

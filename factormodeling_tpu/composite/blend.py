@@ -36,6 +36,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.ops._rank import avg_rank, masked_quantile
 
 __all__ = [
@@ -193,8 +194,10 @@ def composite_static(factors: jnp.ndarray, names, method: str = "zscore",
     gids, prefixes = prefix_group_ids(names)
     if universe is not None:
         factors = jnp.where(universe, factors, jnp.nan)
-    adj = _preprocess(factors, names, pooled=False)
-    proxies = _group_proxies(adj, gids, len(prefixes))  # [G, D, N]
+    with obs_stage("composite/preprocess"):
+        adj = _preprocess(factors, names, pooled=False)
+    with obs_stage("composite/proxies"):
+        proxies = _group_proxies(adj, gids, len(prefixes))  # [G, D, N]
     if method == "zscore":
         normed = _safe_zscore_rows(proxies, universe)
         valid = ~jnp.isnan(normed)
@@ -229,9 +232,11 @@ def composite_weighted(factors: jnp.ndarray, names, selection: jnp.ndarray,
         factors = jnp.where(universe, factors, jnp.nan)
 
     active = selection > 0.0  # [D, F]
-    adj = _preprocess(factors, names, pooled=True, active=active)
+    with obs_stage("composite/preprocess"):
+        adj = _preprocess(factors, names, pooled=True, active=active)
     member = active.astype(factors.dtype)
-    proxies = _group_proxies(adj, gids, g, member_weight=member)  # [G, D, N]
+    with obs_stage("composite/proxies"):
+        proxies = _group_proxies(adj, gids, g, member_weight=member)  # [G, D, N]
 
     onehot = jnp.asarray(np.arange(g)[:, None] == gids, factors.dtype)  # [G, F]
     gw = jnp.einsum("gf,df->dg", onehot, jnp.where(active, selection, 0.0))  # [D, G]

@@ -23,6 +23,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax.scipy.special import betainc
 
+from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.ops._window import masked_shift, rolling_sum, shift
 
 METRIC_COLUMNS = (
@@ -180,16 +181,21 @@ def daily_factor_stats(factors: jnp.ndarray, returns: jnp.ndarray,
     nan = jnp.nan
     out = dict(n_pairs=cnt)
     if "ic" in stats:
-        out["ic"] = jnp.where(enough, _masked_pearson(f, r, valid), nan)
+        with obs_stage("metrics/ic"):
+            out["ic"] = jnp.where(enough, _masked_pearson(f, r, valid), nan)
     if "rank_ic" in stats:
-        out["rank_ic"] = jnp.where(enough, _rank_ic(f, r, valid), nan)
+        # the lax.sort under this scope is the pipeline's dominant single op
+        # at scale — name it so profiles say so without archaeology
+        with obs_stage("metrics/rank_ic"):
+            out["rank_ic"] = jnp.where(enough, _rank_ic(f, r, valid), nan)
     if "factor_return" in stats:
-        f0 = jnp.where(valid, f, 0.0)
-        r0 = jnp.where(valid, r, 0.0)
-        num = (f0 * r0).sum(axis=_ASSET_AXIS)
-        den = (f0 * f0).sum(axis=_ASSET_AXIS)
-        beta = jnp.where(den > 0, num / den, jnp.nan)
-        out["factor_return"] = jnp.where(enough, beta, nan)
+        with obs_stage("metrics/factor_return"):
+            f0 = jnp.where(valid, f, 0.0)
+            r0 = jnp.where(valid, r, 0.0)
+            num = (f0 * r0).sum(axis=_ASSET_AXIS)
+            den = (f0 * f0).sum(axis=_ASSET_AXIS)
+            beta = jnp.where(den > 0, num / den, jnp.nan)
+            out["factor_return"] = jnp.where(enough, beta, nan)
     return out
 
 

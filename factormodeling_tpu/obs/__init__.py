@@ -1,0 +1,52 @@
+"""Runtime observability: named-scope tracing, device-side stage counters,
+and the structured run report.
+
+Three tools, one per time domain (docs/architecture.md section 13):
+
+- :mod:`~factormodeling_tpu.obs.trace` — ``obs.stage(name)`` pushes
+  human-readable stage names into HLO op metadata so profiler traces and
+  HLO dumps of the fused pipeline stop being anonymous fusion walls.
+- :mod:`~factormodeling_tpu.obs.counters` — ``StageCounters``, a
+  diagnostics pytree collected *inside* the jitted research step
+  (universe coverage, NaN share, selection churn, solver/polish tallies),
+  with trace-time structural elision when disabled: outputs stay
+  bit-identical to an uninstrumented build.
+- :mod:`~factormodeling_tpu.obs.report` — ``obs.span(...)`` wall timers
+  with built-in ``block_until_ready`` fences, and :class:`RunReport`,
+  which merges spans, counter summaries, ``polish_stats``, and
+  ``cost_analysis()`` FLOP/byte estimates into one JSONL artifact
+  (rendered by ``tools/trace_report.py``).
+
+Quickstart::
+
+    from factormodeling_tpu import obs
+
+    rep = obs.RunReport("experiment-7")
+    with rep.activate(), obs.collecting():
+        step = build_research_step(names=names, window=20)   # counters on
+        jitted = jax.jit(step)
+        with rep.span("research_step") as sp:
+            out = sp.add(jitted(factors, rets, fr, cap, inv, uni))
+        rep.add_counters("research_step", out.counters)
+        rep.add_cost_analysis("research_step", jitted, factors, rets, fr,
+                              cap, inv, uni)
+    rep.write_jsonl("run_report.jsonl")
+"""
+
+from factormodeling_tpu.obs.counters import (  # noqa: F401
+    StageCounters,
+    collecting,
+    counters_enabled,
+    enable_counters,
+    stage_counters,
+    summarize_counters,
+)
+from factormodeling_tpu.obs.report import (  # noqa: F401
+    RunReport,
+    SpanHandle,
+    active_report,
+    cost_estimate,
+    record_stage,
+    span,
+)
+from factormodeling_tpu.obs.trace import annotate, stage  # noqa: F401

@@ -1,0 +1,231 @@
+"""Host-side run report: span timers, counter summaries, and cost-analysis
+estimates merged into one JSONL/dict artifact.
+
+The library's layers (``parallel/pipeline.py``, ``parallel/sweep.py``,
+``parallel/streaming.py``, the compat ``Simulation``, ``bench.py``) record
+into the *active* report when one is installed — and are exact no-ops when
+none is (the default), so instrumentation costs nothing in production hot
+paths. ``tools/trace_report.py`` renders the JSONL as a per-stage table.
+
+Span timing discipline: JAX dispatch is asynchronous, so a wall-clock window
+that does not fence on its outputs measures dispatch, not compute
+(``tools/lint_timing.py`` enforces this in the benches). ``span(...)``
+builds the fence in: register device outputs on the handle and the exit
+path runs ``jax.block_until_ready`` on them *inside* the measured window.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+from pathlib import Path
+
+__all__ = ["RunReport", "SpanHandle", "active_report", "record_stage",
+           "span", "cost_estimate"]
+
+_ACTIVE: "RunReport | None" = None
+
+
+def active_report() -> "RunReport | None":
+    """The currently installed report (``RunReport.activate``), or None."""
+    return _ACTIVE
+
+
+def record_stage(name: str, **fields) -> None:
+    """Record one stage row into the active report; no-op without one.
+
+    This is the hook the library layers call — cheap enough to leave in hot
+    paths (one global read when inactive)."""
+    if _ACTIVE is not None:
+        _ACTIVE.record(name, **fields)
+
+
+class SpanHandle:
+    """Yielded by :func:`RunReport.span`; lets the body register device
+    outputs to fence on and extra fields to attach to the row."""
+
+    def __init__(self):
+        self._outputs = []
+        self.fields: dict = {}
+
+    def add(self, *outputs):
+        """Register device arrays (or pytrees) whose completion the span
+        must wait for before the clock stops."""
+        self._outputs.extend(outputs)
+        return outputs[0] if len(outputs) == 1 else outputs
+
+
+class RunReport:
+    """Aggregator for one run's observability artifact.
+
+    Rows are dicts with a ``kind`` ("span" | "counters" | "cost" | "stage")
+    and a ``name``; :meth:`write_jsonl` emits one JSON object per row with
+    the report's label/meta folded in. Install as the process-wide sink with
+    :meth:`activate` so library layers can contribute rows::
+
+        rep = RunReport("demo")
+        with rep.activate():
+            with rep.span("research_step") as sp:
+                sp.add(step(*args))
+            rep.add_counters("research_step", out.counters)
+            rep.add_cost_analysis("research_step", step, *args)
+        rep.write_jsonl("run_report.jsonl")
+    """
+
+    def __init__(self, label: str | None = None, meta: dict | None = None):
+        self.label = label
+        self.meta = dict(meta or {})
+        self.rows: list[dict] = []
+
+    # ------------------------------------------------------------- recording
+
+    def record(self, name: str, *, kind: str = "stage", **fields) -> dict:
+        row = {"kind": kind, "name": name, **fields}
+        self.rows.append(row)
+        return row
+
+    @contextmanager
+    def span(self, name: str, **fields):
+        """Wall-clock a block, fencing on registered outputs at exit.
+
+        The handle's :meth:`SpanHandle.add` registers device outputs;
+        ``jax.block_until_ready`` runs on them inside the window so the
+        recorded ``wall_s`` covers compute, not just dispatch. The block is
+        also wrapped in a ``jax.profiler.TraceAnnotation`` so host spans
+        line up with the device trace in the profiler UI. A body that
+        raises still records its (truncated) row, marked ``error: true``
+        so aggregations can tell a crashed stage from a fast one; the
+        exception propagates.
+        """
+        import sys
+
+        import jax
+
+        handle = SpanHandle()
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation(name):
+            try:
+                yield handle
+            finally:
+                if handle._outputs and sys.exc_info()[0] is None:
+                    jax.block_until_ready(handle._outputs)
+                wall = time.perf_counter() - t0
+                err = ({"error": True} if sys.exc_info()[0] is not None
+                       else {})
+                self.record(name, kind="span", wall_s=round(wall, 6),
+                            fenced=bool(handle._outputs),
+                            **{**fields, **handle.fields, **err})
+
+    def add_counters(self, name: str, counters) -> None:
+        """Summarize a :class:`~factormodeling_tpu.obs.counters.StageCounters`
+        pytree (or a plain dict of scalars) into a counters row. None is
+        ignored — callers can pass ``output.counters`` unconditionally."""
+        if counters is None:
+            return
+        if isinstance(counters, dict):
+            self.record(name, kind="counters", counters=counters)
+            return
+        from factormodeling_tpu.obs.counters import summarize_counters
+
+        self.record(name, kind="counters",
+                    counters=summarize_counters(counters))
+
+    def add_cost_analysis(self, name: str, fn, *args, **kwargs) -> dict:
+        """FLOP/byte estimates from ``jit(fn).lower(*args).cost_analysis()``.
+
+        ``fn`` may be a plain traceable callable, an existing jit wrapper,
+        or an already-lowered object. Estimates are XLA's pre-optimization
+        HloCostAnalysis — indicative magnitudes for roofline context, not
+        measured traffic. Failures record an ``error`` row (cost analysis
+        availability varies by backend) rather than raising."""
+        try:
+            if hasattr(fn, "cost_analysis"):      # already Lowered
+                lowered = fn
+            elif hasattr(fn, "lower"):            # jit wrapper
+                lowered = fn.lower(*args, **kwargs)
+            else:
+                import jax
+
+                lowered = jax.jit(fn).lower(*args, **kwargs)
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):     # per-device on some paths
+                ca = ca[0] if ca else {}
+            ca = dict(ca or {})
+            row = self.record(
+                name, kind="cost",
+                flops=float(ca.get("flops", float("nan"))),
+                bytes_accessed=float(ca.get("bytes accessed", float("nan"))))
+            return row
+        except Exception as e:  # pragma: no cover - backend-dependent
+            return self.record(name, kind="cost", error=str(e))
+
+    # ------------------------------------------------------------ lifecycle
+
+    @contextmanager
+    def activate(self):
+        """Install this report as the process-wide sink for
+        :func:`record_stage` (and the layers that call it)."""
+        global _ACTIVE
+        prev = _ACTIVE
+        _ACTIVE = self
+        try:
+            yield self
+        finally:
+            _ACTIVE = prev
+
+    # -------------------------------------------------------------- output
+
+    def to_dict(self) -> dict:
+        return {"label": self.label, "meta": self.meta, "rows": self.rows}
+
+    def write_jsonl(self, path) -> Path:
+        """One JSON object per row (label/meta folded into each, so rows are
+        self-contained for stream processing); returns the path."""
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with path.open("w") as fh:
+            for row in self.rows:
+                out = dict(row)
+                if self.label is not None:
+                    out.setdefault("label", self.label)
+                if self.meta:
+                    out.setdefault("meta", self.meta)
+                fh.write(json.dumps(out, default=_json_default) + "\n")
+        return path
+
+
+def _json_default(o):
+    """Last-resort JSON coercion: numpy scalars/arrays and Paths appear in
+    bench rows and meta dicts."""
+    import numpy as np
+
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, Path):
+        return str(o)
+    return str(o)
+
+
+@contextmanager
+def span(name: str, **fields):
+    """Module-level span: records into the active report when one is
+    installed, else into a throwaway report (still useful for its fence +
+    TraceAnnotation side effects)."""
+    rep = _ACTIVE if _ACTIVE is not None else RunReport()
+    with rep.span(name, **fields) as handle:
+        yield handle
+
+
+def cost_estimate(fn, *args, **kwargs) -> dict:
+    """Standalone ``{"flops": ..., "bytes_accessed": ...}`` estimate of a
+    traceable/jitted function at the given args (NaN fields on failure)."""
+    rep = RunReport()
+    row = rep.add_cost_analysis("estimate", fn, *args, **kwargs)
+    return {k: row.get(k, float("nan"))
+            for k in ("flops", "bytes_accessed")} | (
+        {"error": row["error"]} if "error" in row else {})

@@ -1,0 +1,58 @@
+"""Named-scope trace annotation: human-readable stage names in profiler
+traces and HLO dumps.
+
+The fused research step is one jit; without annotations a captured
+``jax.profiler`` trace (or an HLO dump) of it is a wall of anonymous XLA
+fusions. ``stage(...)`` pushes a name onto JAX's tracing name stack
+(``jax.named_scope``), so every op traced under it carries
+``.../<name>/...`` in its HLO ``op_name`` metadata — the profiler's trace
+viewer and ``compile().as_text()`` both group by it. Dapper-style tracing
+(Sigelman et al., 2010) needs exactly this: names assigned where the work
+is *defined*, propagated for free to where it is *measured*.
+
+Two distinct tools, two scopes of applicability:
+
+- :func:`stage` — TRACE-time annotation, usable inside jitted code; zero
+  runtime cost (the name lives in compiler metadata only).
+- :class:`jax.profiler.TraceAnnotation` (used by ``obs.span``) — HOST-side
+  wall-clock annotation for profiler timelines; meaningless inside a jit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["stage", "annotate"]
+
+
+def stage(name: str):
+    """A ``jax.named_scope`` context manager for one pipeline stage.
+
+    Use around traced code (inside or outside jit)::
+
+        with obs.stage("selection/rolling"):
+            sel = rolling_selection(...)
+
+    Every op traced in the block carries ``name`` in its HLO op_name
+    metadata; profiler traces and HLO dumps group by it. Purely a
+    trace-time construct — compiled code is unchanged (the differential
+    test in ``tests/test_obs.py`` pins outputs bit-identical).
+    """
+    return jax.named_scope(name)
+
+
+def annotate(name: str):
+    """Decorator form of :func:`stage`: wrap a traceable function so its
+    whole body traces under ``name``."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with jax.named_scope(name):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco

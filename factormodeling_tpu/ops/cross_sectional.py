@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.ops._rank import avg_rank, masked_quantile
 
 __all__ = [
@@ -64,14 +65,15 @@ def cs_rank(x: jnp.ndarray, universe: jnp.ndarray | None = None,
     size *including NaN rows* (reference quirk, ``operations.py:58-60``);
     single-row dates -> 0.5. ``tie_order`` (int, lower = earlier) resolves
     ``method='first'`` ties; defaults to asset-column order."""
-    x = _mask_input(x, universe)
-    r = avg_rank(x, axis=_ASSET_AXIS, method=method, tie_order=tie_order)
-    n = _universe_count(x, universe)
-    out = (r - 1.0) / (n - 1.0)
-    out = jnp.where(n == 1, 0.5, out)
-    if universe is not None:
-        out = jnp.where(universe, out, jnp.nan)
-    return out
+    with obs_stage("ops/cs_rank"):
+        x = _mask_input(x, universe)
+        r = avg_rank(x, axis=_ASSET_AXIS, method=method, tie_order=tie_order)
+        n = _universe_count(x, universe)
+        out = (r - 1.0) / (n - 1.0)
+        out = jnp.where(n == 1, 0.5, out)
+        if universe is not None:
+            out = jnp.where(universe, out, jnp.nan)
+        return out
 
 
 def cs_winsor(x: jnp.ndarray, limits=(0.01, 0.99), min_valid: int = 5,
@@ -106,9 +108,10 @@ def cs_filter_center(x: jnp.ndarray, center=(0.3, 0.7),
 def cs_zscore(x: jnp.ndarray, universe: jnp.ndarray | None = None) -> jnp.ndarray:
     """Per-date z-score, ddof=0 (reference ``operations.py:77``). A constant
     date gives 0/0 -> NaN, matching pandas arithmetic."""
-    x = _mask_input(x, universe)
-    mean, std, _ = _masked_moments(x, ddof=0)
-    return (x - mean) / std
+    with obs_stage("ops/cs_zscore"):
+        x = _mask_input(x, universe)
+        mean, std, _ = _masked_moments(x, ddof=0)
+        return (x - mean) / std
 
 
 def cs_bool(cond: jnp.ndarray, true_value, false_value) -> jnp.ndarray:

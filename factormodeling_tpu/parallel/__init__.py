@@ -43,6 +43,7 @@ from factormodeling_tpu.parallel.streaming import (  # noqa: F401
     streamed_factor_stats,
     streamed_linear_research,
     streamed_weighted_composite,
+    streaming_cache_stats,
 )
 from factormodeling_tpu.parallel.sweep import (  # noqa: F401
     SweepOutput,

@@ -27,6 +27,9 @@ from factormodeling_tpu.backtest.pnl import DailyResult
 from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.composite import composite_weighted
 from factormodeling_tpu.metrics.factor_metrics import nan_mean_std
+from factormodeling_tpu.obs import counters as obs_counters
+from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.parallel.mesh import panel_sharding, stack_sharding
 from factormodeling_tpu.selection import rolling_selection
 
@@ -57,6 +60,11 @@ class ResearchOutput(NamedTuple):
     signal: jnp.ndarray      # [D, N] composite signal
     sim: SimulationOutput
     summary: ResearchSummary
+    # StageCounters when the step was built with counter collection on
+    # (obs.collecting() / collect_counters=True), else None — a None pytree
+    # leaf is structurally absent, so the disabled step's HLO and outputs
+    # are bit-identical to a build without the obs layer.
+    counters: obs_counters.StageCounters | None = None
 
 
 def _nan_mean_std(x: jnp.ndarray):
@@ -84,7 +92,8 @@ def build_research_step(*, names, window: int,
                         select_method: str = "icir_top",
                         select_kwargs: dict[str, Any] | None = None,
                         blend_method: str = "zscore",
-                        sim_kwargs: dict[str, Any] | None = None):
+                        sim_kwargs: dict[str, Any] | None = None,
+                        collect_counters: bool | None = None):
     """Close the static config over a jittable
     ``step(factors, returns, factor_ret, cap_flag, investability, universe)``.
 
@@ -94,26 +103,46 @@ def build_research_step(*, names, window: int,
       factor_ret: ``float[D, F]`` precomputed per-date factor returns.
       cap_flag / investability: ``[D, N]`` panels.
       universe: ``bool[D, N]`` membership mask.
+
+    ``collect_counters`` gates device-side
+    :class:`~factormodeling_tpu.obs.counters.StageCounters` collection in
+    the step's output (None -> the ``obs.collecting()`` global, read here
+    at build time). When off, the counter subgraph is never traced —
+    structural elision, not a masked branch — so outputs are bit-identical
+    to an uninstrumented build. Every stage traces under an
+    ``obs.stage(...)`` named scope either way (metadata only, free).
     """
     names = tuple(names)
     select_kwargs = dict(select_kwargs or {})
     sim_kwargs = dict(sim_kwargs or {})
+    if collect_counters is None:
+        collect_counters = obs_counters.counters_enabled()
 
     def step(factors, returns, factor_ret, cap_flag, investability,
              universe) -> ResearchOutput:
-        selection = rolling_selection(
-            factors, returns, factor_ret, window,
-            method=select_method, method_kwargs=select_kwargs,
-            universe=universe)
-        signal = composite_weighted(factors, names, selection,
-                                    method=blend_method, universe=universe)
+        with obs_stage("selection/rolling"):
+            selection = rolling_selection(
+                factors, returns, factor_ret, window,
+                method=select_method, method_kwargs=select_kwargs,
+                universe=universe)
+        with obs_stage("composite/blend"):
+            signal = composite_weighted(factors, names, selection,
+                                        method=blend_method,
+                                        universe=universe)
         settings = SimulationSettings(
             returns=returns, cap_flag=cap_flag,
             investability_flag=investability, universe=universe,
             **sim_kwargs)
         sim = run_simulation(signal, settings)
+        with obs_stage("pipeline/summary"):
+            summary = result_summary(sim.result)
+        counters = None
+        if collect_counters:
+            with obs_stage("obs/stage_counters"):
+                counters = obs_counters.stage_counters(factors, universe,
+                                                       selection, sim)
         return ResearchOutput(selection=selection, signal=signal, sim=sim,
-                              summary=result_summary(sim.result))
+                              summary=summary, counters=counters)
 
     return step
 
@@ -124,11 +153,14 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
                                blend_method: str = "zscore",
                                sim_kwargs: dict[str, Any] | None = None,
                                factor_axis: str = "factor",
-                               date_axis: str = "date"):
+                               date_axis: str = "date",
+                               collect_counters: bool | None = None):
     """Jit the research step over a 2-D mesh with the canonical shardings.
 
     Returns ``(jitted_step, shard_inputs)`` where ``shard_inputs`` device_puts
     a raw input tuple onto the mesh with the declared shardings.
+    ``collect_counters`` is threaded to :func:`build_research_step`; the
+    counter reductions shard like the stage they observe.
     """
     f_size = mesh.shape[factor_axis]
     if len(tuple(names)) % f_size:
@@ -141,7 +173,12 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
                                select_method=select_method,
                                select_kwargs=select_kwargs,
                                blend_method=blend_method,
-                               sim_kwargs=sim_kwargs)
+                               sim_kwargs=sim_kwargs,
+                               collect_counters=collect_counters)
+    record_stage("parallel/pipeline", kind="stage",
+                 mesh_shape=dict(mesh.shape), factors=len(tuple(names)),
+                 window=window, select_method=select_method,
+                 blend_method=blend_method)
     fs = stack_sharding(mesh, factor_axis, date_axis)           # [F, D, N]
     ps = panel_sharding(mesh, date_axis)                        # [D, N]
     frs = NamedSharding(mesh, PartitionSpec(date_axis, factor_axis))  # [D, F]

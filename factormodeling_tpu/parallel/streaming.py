@@ -48,9 +48,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from factormodeling_tpu import ops
 from factormodeling_tpu.metrics import daily_factor_stats
+from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.trace import stage as obs_stage
 
 __all__ = ["chunk_sharding", "chunk_slices", "clear_streaming_cache",
-           "host_array_source",
+           "host_array_source", "streaming_cache_stats",
            "streamed_factor_stats", "streamed_linear_research",
            "streamed_weighted_composite"]
 
@@ -69,12 +71,30 @@ __all__ = ["chunk_sharding", "chunk_slices", "clear_streaming_cache",
 # evicted) and :func:`clear_streaming_cache` releases everything on demand.
 _KERNEL_CACHE_SIZE = 16
 _kernel_cache: "dict[tuple, object]" = {}
+# hit/miss/eviction tallies: a recompilation storm (fresh lambda sources,
+# churning configs) shows up as a miss rate near 1 instead of a silent
+# minutes-long slowdown — see streaming_cache_stats()
+_cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
 
 
 def clear_streaming_cache() -> None:
     """Drop every cached per-chunk kernel (and the source closures — with
-    their captured device buffers — that the kernels pin)."""
+    their captured device buffers — that the kernels pin). Also resets the
+    :func:`streaming_cache_stats` counters."""
     _kernel_cache.clear()
+    _cache_stats.update(hits=0, misses=0, evictions=0)
+
+
+def streaming_cache_stats() -> dict:
+    """Snapshot of the per-chunk kernel cache counters:
+    ``{"hits", "misses", "evictions", "size"}`` since the last
+    :func:`clear_streaming_cache`. A miss is a kernel (re)build — i.e. a
+    fresh jit wrapper whose first call compiles; a streaming pipeline in
+    steady state should show hits ~ calls and misses ~ distinct
+    (source, config) pairs. A miss count growing with every call means an
+    unstable source/weight-fn identity is defeating the cache (the
+    recompilation storm documented in the cache note above)."""
+    return {**_cache_stats, "size": len(_kernel_cache)}
 
 
 def _cached_kernel(source, config, build):
@@ -84,9 +104,13 @@ def _cached_kernel(source, config, build):
     fn = _kernel_cache.pop(key, None)
     if fn is None:
         fn = build()
+        _cache_stats["misses"] += 1
+    else:
+        _cache_stats["hits"] += 1
     _kernel_cache[key] = fn  # (re)insert at the end: dict order is recency
     while len(_kernel_cache) > _KERNEL_CACHE_SIZE:
         _kernel_cache.pop(next(iter(_kernel_cache)))
+        _cache_stats["evictions"] += 1
     return fn
 
 
@@ -212,6 +236,8 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
     else:
         parts = [one(chunk_put(chunk), returns, universe)
                  for chunk in _prefetched(source, n_chunks, prefetch)]
+    record_stage("streaming/stats", chunks=n_chunks, fused=fuse_source,
+                 prefetch=prefetch, cache=streaming_cache_stats())
     return {k: jnp.concatenate([p[k] for p in parts], axis=0)
             for k in parts[0]}
 
@@ -222,9 +248,10 @@ def _stats_kernel(fused_source, shift_periods: int, stats: tuple):
 
     def build():
         def kernel(fac, returns, universe):
-            return daily_factor_stats(fac, returns,
-                                      shift_periods=shift_periods,
-                                      universe=universe, stats=stats)
+            with obs_stage("streaming/stats"):
+                return daily_factor_stats(fac, returns,
+                                          shift_periods=shift_periods,
+                                          universe=universe, stats=stats)
 
         if fused_source is None:
             return jax.jit(kernel)
@@ -329,6 +356,9 @@ def streamed_linear_research(source: Callable[[int], jnp.ndarray],
         s = u.sum(axis=0)
         norm = s if norm is None else norm + s
 
+    record_stage("streaming/linear_research", chunks=n_chunks,
+                 fused=fuse_source, prefetch=prefetch,
+                 cache=streaming_cache_stats())
     out = {k: jnp.concatenate([p[k] for p in stat_parts], axis=0)
            for k in stat_parts[0]}
     out["unnormalized_weights"] = jnp.concatenate(u_parts, axis=0)
@@ -343,13 +373,14 @@ def _linear_research_kernel(fused_source, chunk_weight_fn, transform,
                             shift_periods: int, stats: tuple):
     def build():
         def kernel(fac, returns, universe):
-            stats_d = daily_factor_stats(fac, returns,
-                                         shift_periods=shift_periods,
-                                         universe=universe, stats=stats)
-            u = chunk_weight_fn(stats_d)                      # [C, D]
-            z = _apply_transform(fac, universe, transform)
-            part = jnp.einsum("fd,fdn->dn", u, jnp.nan_to_num(z))
-            return stats_d, u, part
+            with obs_stage("streaming/linear_research"):
+                stats_d = daily_factor_stats(fac, returns,
+                                             shift_periods=shift_periods,
+                                             universe=universe, stats=stats)
+                u = chunk_weight_fn(stats_d)                      # [C, D]
+                z = _apply_transform(fac, universe, transform)
+                part = jnp.einsum("fd,fdn->dn", u, jnp.nan_to_num(z))
+                return stats_d, u, part
 
         if fused_source is None:
             return jax.jit(kernel)
@@ -412,6 +443,9 @@ def streamed_weighted_composite(source: Callable[[int], jnp.ndarray],
     for w, arg0 in zip(chunk_weights, chunks):
         part = one(arg0, jnp.asarray(w), universe)
         total = part if total is None else total + part
+    record_stage("streaming/composite", chunks=len(chunk_weights),
+                 fused=fuse_source, prefetch=prefetch,
+                 cache=streaming_cache_stats())
     return total
 
 
@@ -421,9 +455,11 @@ def _composite_kernel(fused_source, transform):
 
     def build():
         def kernel(fac, w, universe):
-            return jnp.einsum(
-                "fd,fdn->dn", w,
-                jnp.nan_to_num(_apply_transform(fac, universe, transform)))
+            with obs_stage("streaming/composite"):
+                return jnp.einsum(
+                    "fd,fdn->dn", w,
+                    jnp.nan_to_num(_apply_transform(fac, universe,
+                                                    transform)))
 
         if fused_source is None:
             return jax.jit(kernel)

@@ -32,6 +32,8 @@ except ImportError:  # jax 0.4.x: experimental namespace, same semantics
 from factormodeling_tpu.backtest.pnl import daily_portfolio_returns
 from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.multimanager import compute_manager_weights
+from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.parallel.pipeline import result_summary
 
 __all__ = ["SweepOutput", "combo_weight_matrix", "manager_sweep",
@@ -81,14 +83,18 @@ def _combine_and_pnl(books: jnp.ndarray, combo_weights: jnp.ndarray,
             total_log_return=summ.total_log_return, sharpe=summ.sharpe,
             mean_turnover=summ.mean_turnover)
 
-    return lax.map(one_combo, combo_weights, batch_size=combo_batch)
+    with obs_stage("sweep/combo_pnl"):
+        return lax.map(one_combo, combo_weights, batch_size=combo_batch)
 
 
 def manager_sweep(factors: jnp.ndarray, combo_weights: jnp.ndarray,
                   settings: SimulationSettings, *,
                   combo_batch: int = 8) -> SweepOutput:
     """Single-device sweep: one book pass, then every combo's backtest."""
-    books, _, _ = compute_manager_weights(factors, settings)
+    record_stage("parallel/sweep", combos=int(combo_weights.shape[0]),
+                 factors=int(factors.shape[0]), combo_batch=combo_batch)
+    with obs_stage("sweep/books"):
+        books, _, _ = compute_manager_weights(factors, settings)
     return _combine_and_pnl(books, combo_weights, settings, combo_batch)
 
 
@@ -129,7 +135,8 @@ def make_sharded_manager_sweep(mesh: Mesh, *, combo_axis: str = "combo",
     @jax.jit
     def sweep(factors, combo_weights, settings):
         factors = jax.lax.with_sharding_constraint(factors, factor_sharded)
-        books, _, _ = compute_manager_weights(factors, settings)
+        with obs_stage("sweep/books"):
+            books, _, _ = compute_manager_weights(factors, settings)
         books = jax.lax.with_sharding_constraint(books, factor_sharded)
         return sharded(books, combo_weights, settings)
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from factormodeling_tpu.metrics import daily_factor_stats, rolling_metrics
+from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.ops._window import rolling_sum, shift
 from factormodeling_tpu.selection.selectors import (
     FACTOR_SELECTION_METHODS,
@@ -79,8 +80,10 @@ def build_selection_context(factors: jnp.ndarray, returns: jnp.ndarray,
         # traversal entirely (eager callers get no XLA DCE to save them)
         metrics_win = {}
         return _finish_context(metrics_win, factor_ret, window)
-    daily = daily_factor_stats(factors, returns, shift_periods=shift_periods,
-                               universe=universe, stats=stats)
+    with obs_stage("selection/daily_stats"):
+        daily = daily_factor_stats(factors, returns,
+                                   shift_periods=shift_periods,
+                                   universe=universe, stats=stats)
     # The reference applies its second exposure shift INSIDE the window slice
     # (factor_selector.py:84 then :33), so the slice's first date has all-NaN
     # exposures and contributes no pairs: a window of W dates aggregates only
@@ -90,9 +93,10 @@ def build_selection_context(factors: jnp.ndarray, returns: jnp.ndarray,
     # observation while the whole-sample masked shift keeps it, a known,
     # documented approximation (exactness would force the reference's own
     # O(D*W*F) per-window recompute back in).
-    rm = rolling_metrics(daily, max(window - 1, 1))
-    # selectors for date i read the window ending at i-1 (today excluded)
-    metrics_win = {k: shift(v, 1, axis=-1) for k, v in rm.items()}
+    with obs_stage("selection/rolling_metrics"):
+        rm = rolling_metrics(daily, max(window - 1, 1))
+        # selectors for date i read the window ending at i-1 (today excluded)
+        metrics_win = {k: shift(v, 1, axis=-1) for k, v in rm.items()}
     return _finish_context(metrics_win, factor_ret, window)
 
 
@@ -128,10 +132,13 @@ def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
     # the full table — their consumption is unknown
     needs_fn = _METRIC_NEEDS.get(selector)
     needs = needs_fn(method_kwargs or {}) if needs_fn else _ALL_STATS
-    ctx = build_selection_context(factors, returns, factor_ret, window,
-                                  universe=universe, shift_periods=shift_periods,
-                                  stats=needs)
-    raw = selector(ctx, **(method_kwargs or {}))  # [D, F]
+    with obs_stage("selection/context"):
+        ctx = build_selection_context(factors, returns, factor_ret, window,
+                                      universe=universe,
+                                      shift_periods=shift_periods,
+                                      stats=needs)
+    with obs_stage(f"selection/selector/{method}"):
+        raw = selector(ctx, **(method_kwargs or {}))  # [D, F]
 
     d = factor_ret.shape[0]
     i = jnp.arange(d)

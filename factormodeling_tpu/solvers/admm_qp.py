@@ -502,55 +502,61 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
     # Force full-f32 dots for everything traced in the loop; the matvecs
     # are tiny and latency-bound, so the extra MXU passes are free.
     with jax.default_matmul_precision("highest"):
-        if unroll > 1:
-            # TPU: Python-level segment schedule -> static bounds -> unrolled
-            # bodies (each segment traces separately; segment counts are
-            # small). iters=0 still runs one zero-length segment (its rho
-            # balancing sees the untouched iterates), like the rolled path.
-            schedule = ([min(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
-                         for k in range(-(-iters // _ADAPT_EVERY))] or [0])
-            for seg_len in schedule:
-                carry = segment(carry, seg_len, max(min(seg_len, unroll), 1))
-        else:
-            # rolled path: one traced segment body inside a fori_loop
-            # (cheapest to compile; the last segment runs the remainder)
-            def seg_k(k, c):
-                seg_len = jnp.minimum(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
-                return segment(c, seg_len, 1)
+        with jax.named_scope("solver/admm"):
+            if unroll > 1:
+                # TPU: Python-level segment schedule -> static bounds ->
+                # unrolled bodies (each segment traces separately; segment
+                # counts are small). iters=0 still runs one zero-length
+                # segment (its rho balancing sees the untouched iterates),
+                # like the rolled path.
+                schedule = ([min(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
+                             for k in range(-(-iters // _ADAPT_EVERY))] or [0])
+                for seg_len in schedule:
+                    carry = segment(carry, seg_len,
+                                    max(min(seg_len, unroll), 1))
+            else:
+                # rolled path: one traced segment body inside a fori_loop
+                # (cheapest to compile; the last segment runs the remainder)
+                def seg_k(k, c):
+                    seg_len = jnp.minimum(_ADAPT_EVERY,
+                                          iters - k * _ADAPT_EVERY)
+                    return segment(c, seg_len, 1)
 
-            n_seg = max(-(-iters // _ADAPT_EVERY), 1)  # ceil: total == iters
-            carry = lax.fori_loop(0, n_seg, seg_k, carry)
-        x, z, u, rho = carry
-        x = x_step(factor(rho), z, u, rho)  # final equality-exact x-step
-        prim = jnp.max(jnp.abs(x - z))
+                n_seg = max(-(-iters // _ADAPT_EVERY), 1)  # ceil == iters
+                carry = lax.fori_loop(0, n_seg, seg_k, carry)
+            x, z, u, rho = carry
+            x = x_step(factor(rho), z, u, rho)  # final equality-exact x-step
+            prim = jnp.max(jnp.abs(x - z))
         nan = jnp.full((), jnp.nan, dtype)
         if polish_ops is None:
             accepted = jnp.zeros((), bool)
             pre_r = post_r = nan
         else:
-            mv, masked_solver = polish_ops
-            x_p, nu = _polish_candidate(mv, masked_solver, prob, q, l1, z)
+            with jax.named_scope("solver/polish"):
+                mv, masked_solver = polish_ops
+                x_p, nu = _polish_candidate(mv, masked_solver, prob, q, l1, z)
 
-            # Guarded acceptance, mirroring OSQP's: the polished point must
-            # be (a) no less feasible than the exit x and (b) no worse in
-            # objective than the BOX-PROJECTED exit iterate. The projection
-            # makes (b) a feasible-vs-feasible comparison; its remaining
-            # equality drift (<= K * pre-residual) can push the projected
-            # objective below the true optimum by at most |nu|_1 * drift, so
-            # the slack carries that dual-scaled term — without it, a
-            # correct polish of a loose f32 iterate is spuriously rejected.
-            pre_r = _box_eq_residual(prob, x)
-            post_r = _box_eq_residual(prob, x_p)
-            obj_ref = _qp_objective(mv, prob, q, l1,
-                                    jnp.clip(x, prob.lo, prob.hi))
-            slack = (_POLISH_OBJ_TOL * (1.0 + jnp.abs(obj_ref))
-                     + jnp.abs(nu).sum() * pre_r)
-            accepted = (jnp.all(jnp.isfinite(x_p))
-                        & (post_r <= pre_r + _POLISH_RES_TOL)
-                        & (_qp_objective(mv, prob, q, l1, x_p)
-                           <= obj_ref + slack))
-            x = jnp.where(accepted, x_p, x)
-            prim = jnp.where(accepted, post_r, prim)
+                # Guarded acceptance, mirroring OSQP's: the polished point
+                # must be (a) no less feasible than the exit x and (b) no
+                # worse in objective than the BOX-PROJECTED exit iterate. The
+                # projection makes (b) a feasible-vs-feasible comparison; its
+                # remaining equality drift (<= K * pre-residual) can push the
+                # projected objective below the true optimum by at most
+                # |nu|_1 * drift, so the slack carries that dual-scaled term
+                # — without it, a correct polish of a loose f32 iterate is
+                # spuriously rejected.
+                pre_r = _box_eq_residual(prob, x)
+                post_r = _box_eq_residual(prob, x_p)
+                obj_ref = _qp_objective(mv, prob, q, l1,
+                                        jnp.clip(x, prob.lo, prob.hi))
+                slack = (_POLISH_OBJ_TOL * (1.0 + jnp.abs(obj_ref))
+                         + jnp.abs(nu).sum() * pre_r)
+                accepted = (jnp.all(jnp.isfinite(x_p))
+                            & (post_r <= pre_r + _POLISH_RES_TOL)
+                            & (_qp_objective(mv, prob, q, l1, x_p)
+                               <= obj_ref + slack))
+                x = jnp.where(accepted, x_p, x)
+                prim = jnp.where(accepted, post_r, prim)
     return ADMMResult(x=x, z=z, primal_residual=prim, u=u, rho=rho,
                       polished=accepted, polish_pre_residual=pre_r,
                       polish_post_residual=post_r)

@@ -10,8 +10,10 @@ import pytest
 from factormodeling_tpu.backtest import (
     SimulationSettings,
     check_anomalies,
+    polish_stats,
     run_simulation,
 )
+from factormodeling_tpu.backtest.diagnostics import SolverDiagnostics
 
 D, N = 14, 10
 
@@ -81,6 +83,73 @@ def test_underconverged_admm_flags_residual(rng):
     assert np.nanmax(resid[live]) > 1e-3
     with pytest.warns(UserWarning, match="primal residual"):
         check_anomalies(out.diagnostics)
+
+
+def _diag(primal, ok, long_sum, short_sum, active, polished, pre, post):
+    return SolverDiagnostics(
+        primal_residual=np.asarray(primal, float),
+        solver_ok=np.asarray(ok, bool),
+        long_sum=np.asarray(long_sum, float),
+        short_sum=np.asarray(short_sum, float),
+        active=np.asarray(active, bool),
+        polished=np.asarray(polished, bool),
+        polish_pre_residual=np.asarray(pre, float),
+        polish_post_residual=np.asarray(post, float))
+
+
+def test_zero_day_diagnostics_warning_free():
+    """D=0 diagnostics (an empty backtest window): every polish_stats field
+    NaN/0, check_anomalies silent, and no numpy RuntimeWarning escapes
+    either aggregation."""
+    e = np.zeros((0,))
+    diag = _diag(e, e, e, e, e, e, e, e)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        stats = polish_stats(diag)
+        assert check_anomalies(diag, name="empty") == []
+    assert stats["attempted"] == 0 and stats["accepted"] == 0
+    for k in ("accept_rate", "pre_residual_mean", "pre_residual_p99",
+              "post_residual_mean", "post_residual_p99"):
+        assert np.isnan(stats[k]), k
+
+
+def test_all_rejected_polish_warning_free():
+    """Every polish candidate evaluated but rejected (non-finite
+    candidates): accept_rate is exactly 0, pre aggregates stay finite, post
+    aggregates are NaN — with no all-NaN-slice RuntimeWarning."""
+    d = 4
+    diag = _diag(primal=np.full(d, 1e-4), ok=np.ones(d),
+                 long_sum=np.ones(d), short_sum=-np.ones(d),
+                 active=np.ones(d), polished=np.zeros(d),
+                 pre=np.full(d, 2e-3), post=np.full(d, np.nan))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        stats = polish_stats(diag)
+        assert check_anomalies(diag, name="rejected", warn=False) == []
+    assert stats["attempted"] == d and stats["accepted"] == 0
+    assert stats["accept_rate"] == 0.0
+    np.testing.assert_allclose(stats["pre_residual_mean"], 2e-3)
+    assert np.isnan(stats["post_residual_mean"])
+    assert np.isnan(stats["post_residual_p99"])
+
+
+def test_all_inactive_simulation_reports_nothing(rng):
+    """An all-zero signal trades nothing: every day inactive, polish never
+    attempted, and both host aggregations stay silent (the reference prints
+    nothing for empty legs either)."""
+    returns, cap, invest, _ = make_market(rng)
+    s = settings_for(returns, cap, invest, method="mvo_turnover",
+                     max_weight=0.5, lookback_period=6, qp_iters=5)
+    out = run_simulation(jnp.zeros((D, N)), s)
+    diag = out.diagnostics
+    assert not np.asarray(diag.active).any()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        stats = polish_stats(diag)
+        assert check_anomalies(diag, name="flat") == []
+    assert stats["attempted"] == 0
+    assert np.isnan(stats["accept_rate"])
+    assert np.isnan(np.asarray(diag.polish_pre_residual)).all()
 
 
 def test_compat_simulation_warns_on_infeasible_caps(rng):

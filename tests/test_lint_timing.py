@@ -1,0 +1,127 @@
+"""tools/lint_timing.py as a tier-1 gate: the benches' perf_counter windows
+must fence (or declare host-synchrony), and the linter itself must catch
+the async-dispatch timing bug class it exists for."""
+
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO / "tools") not in sys.path:
+    sys.path.insert(0, str(REPO / "tools"))
+
+import lint_timing  # noqa: E402
+
+
+def test_repo_timing_surface_is_clean():
+    """bench.py and every tools/ script pass both rules — the actual gate."""
+    findings = lint_timing.lint_paths(lint_timing.default_targets(REPO))
+    assert findings == []
+
+
+def _lint_snippet(tmp_path, code):
+    f = tmp_path / "snippet.py"
+    f.write_text(textwrap.dedent(code))
+    return lint_timing.lint_file(f)
+
+
+def test_unfenced_window_is_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import time
+
+        def bad(step, x):
+            t0 = time.perf_counter()
+            step(x)                      # async: nothing forces completion
+            return time.perf_counter() - t0
+        """)
+    assert len(findings) == 1
+    assert "perf_counter window" in findings[0]
+
+
+def test_fenced_window_passes(tmp_path):
+    assert _lint_snippet(tmp_path, """
+        import time, jax
+
+        def good(step, x):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(x))
+            return time.perf_counter() - t0
+        """) == []
+
+
+def test_transitive_fence_through_local_function_passes(tmp_path):
+    """A window whose only call is a local function that itself fences —
+    the bench.py full_pipeline pattern."""
+    assert _lint_snippet(tmp_path, """
+        import time
+
+        def _fence(x):
+            return float(x)
+
+        def run(step, x):
+            def pipeline():
+                out = step(x)
+                _fence(out)
+                return out
+
+            t0 = time.perf_counter()
+            pipeline()
+            return time.perf_counter() - t0
+        """) == []
+
+
+def test_host_sync_pragma_exempts_window(tmp_path):
+    assert _lint_snippet(tmp_path, """
+        import time, numpy as np
+
+        def baseline(a):
+            t0 = time.perf_counter()  # timing: host-sync (pure numpy)
+            np.linalg.eigh(a)
+            return time.perf_counter() - t0
+        """) == []
+
+
+def test_unfenced_harness_callable_is_flagged(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def _time_fn(fn, repeats=3):  # timing: fenced-callable
+            return 0.0
+
+        def bench(step, x):
+            return _time_fn(lambda: step(x))   # no fence in the lambda
+        """)
+    assert len(findings) == 1
+    assert "_time_fn" in findings[0]
+
+
+def test_fenced_factory_callable_passes(tmp_path):
+    """_time_fn(make_chained(...)) resolves through the factory's nested
+    fencing def — the bench.py rolling_ops pattern."""
+    assert _lint_snippet(tmp_path, """
+        def _fence(x):
+            return float(x)
+
+        def _time_fn(fn, repeats=3):  # timing: fenced-callable
+            return 0.0
+
+        def make_chained(step, x):
+            def chained():
+                _fence(step(x))
+            return chained
+
+        def bench(step, x):
+            return _time_fn(make_chained(step, x))
+        """) == []
+
+
+def test_cli_reports_findings(tmp_path, capsys):
+    f = tmp_path / "bad.py"
+    f.write_text("import time\n"
+                 "def bad(step):\n"
+                 "    t0 = time.perf_counter()\n"
+                 "    step()\n"
+                 "    return time.perf_counter() - t0\n")
+    rc = lint_timing.main([str(f)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "1 finding(s)" in out
+    rc_clean = lint_timing.main([str(REPO / "tools" / "trace_report.py")])
+    assert rc_clean == 0

@@ -75,7 +75,7 @@ def rank_ic_baseline(db: int) -> float:
     from scipy.stats import rankdata
 
     factor, rets = _rank_ic_data()
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (numpy/scipy loop)
     for t in range(1, db + 1):
         v = ~np.isnan(factor[0, t - 1]) & ~np.isnan(rets[t])
         np.corrcoef(rankdata(factor[0, t - 1, v]), rets[t, v])
@@ -95,7 +95,7 @@ def composite_baseline(fb: int) -> float:
     idx = pd.MultiIndex.from_product([range(d), range(n)],
                                      names=["date", "symbol"])
     gser = pd.Series(groups.ravel(), index=idx)
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (pandas groupby chain)
     for i in range(fb):
         s = pd.Series(stack[i].ravel(), index=idx)
         z = s.groupby(level="date").transform(
@@ -115,7 +115,7 @@ def cs_ols_baseline(db: int) -> float:
          + rng.normal(scale=0.02, size=(d, n))).astype(np.float32)
     y[rng.uniform(size=(d, n)) < 0.03] = np.nan
 
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (numpy lstsq loop)
     for t in range(db):
         v = ~np.isnan(y[t])
         a = np.stack([x[i, t, v] for i in range(f)] + [np.ones(v.sum())], 1)
@@ -140,12 +140,16 @@ def risk_model_baseline(nb: int, parts: dict | None = None) -> float:
     rets[rng.uniform(size=(d, n)) < 0.02] = np.nan
 
     sub = np.nan_to_num(rets[:, :nb]).astype(np.float64)
+    # timing: host-sync — every interval below times a plain numpy op
     t0 = time.perf_counter()
     c = sub - sub.mean(0)
+    # timing: host-sync
     t1 = time.perf_counter()
     gram = c @ c.T
+    # timing: host-sync
     t2 = time.perf_counter()
     evals, evecs = np.linalg.eigh(gram)
+    # timing: host-sync
     t3 = time.perf_counter()
     _ = (c.T @ evecs[:, -k:])
     t4 = time.perf_counter()
@@ -173,7 +177,7 @@ def sweep_baseline(db: int) -> float:
 
     fb = 5
     idx_dense = factors[:fb, :db, :]
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # timing: host-sync (pandas oracle pass)
     books = []
     for i in range(fb):
         w, _ = po.o_daily_trade_list(po.dense_to_long(idx_dense[i]), "equal")

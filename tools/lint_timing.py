@@ -1,0 +1,227 @@
+"""Static lint for async-dispatch timing bugs in the benches.
+
+JAX dispatch is asynchronous: a ``time.perf_counter()`` window around a jit
+call measures *dispatch*, not compute, unless something inside the window
+forces completion — ``jax.block_until_ready``, the benches' ``_fence``
+(a materializing scalar read), or a function that transitively does one of
+those. A missing fence publishes a wildly optimistic number and is invisible
+in review (the code "works"); this lint makes the fence a checked invariant
+over ``bench.py`` and ``tools/``. It runs as a tier-1 test
+(``tests/test_lint_timing.py``).
+
+Rules
+-----
+**Rule A (windows fence).** Every measurement window — the statements
+between ``t0 = time.perf_counter()`` and the ``... - t0`` readout — must
+contain a *fencing call*: ``block_until_ready``, ``_fence`` / ``fence``, or
+a call to a function defined in the same file whose body transitively
+contains one. Windows that intentionally time host-synchronous work (numpy/
+pandas baseline loops, disk writes) declare it with a ``# timing:
+host-sync`` pragma on the ``t0`` line; windows whose fence lives inside an
+opaque callable parameter declare ``# timing: fenced-callable`` (and rule B
+audits their call sites).
+
+**Rule B (harness callables fence).** Every callable handed to the shared
+timing harnesses ``_time_fn`` / ``_time_chained`` must transitively reach a
+fence: a lambda containing a fencing call, a local function whose body
+fences, or a call to a local factory whose body (including nested defs)
+fences. Call sites timing host-synchronous work carry the same ``# timing:
+host-sync`` pragma on the call line.
+
+The transitive closure is per-file (the benches are self-contained by
+design); cross-module fences need the pragma, which doubles as
+documentation of *why* the window is sound.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+__all__ = ["lint_file", "lint_paths", "main"]
+
+#: call names that force device completion inside a timing window
+FENCE_NAMES = {"block_until_ready", "_fence", "fence"}
+#: the shared harnesses whose callable arguments rule B audits
+#: (_time_chained is NOT here: it builds the fenced chain itself, so its
+#: callable argument legitimately has no fence of its own)
+HARNESSES = {"_time_fn"}
+PRAGMA = "# timing:"
+
+
+def _call_name(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _is_perf_counter(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and _call_name(node) == "perf_counter")
+
+
+def _pragma_lines(source: str) -> dict[int, str]:
+    """lineno -> pragma text for every ``# timing:`` comment."""
+    out = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        if PRAGMA in line:
+            out[i] = line.split(PRAGMA, 1)[1].strip()
+    return out
+
+
+def _fenced_functions(tree: ast.AST) -> set[str]:
+    """Names of functions (any nesting level) whose body transitively
+    contains a fencing call — fixpoint over the per-file call graph.
+    A factory whose *nested* def fences counts as fenced itself (calling it
+    builds a fencing callable; rule B resolves ``_time_fn(make_x(...))``
+    through this)."""
+    funcs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[node.name] = node
+
+    def direct_fence(fn_node) -> bool:
+        return any(isinstance(n, ast.Call) and _call_name(n) in FENCE_NAMES
+                   for n in ast.walk(fn_node))
+
+    fenced = {name for name, node in funcs.items() if direct_fence(node)}
+    changed = True
+    while changed:
+        changed = False
+        for name, node in funcs.items():
+            if name in fenced:
+                continue
+            calls = {_call_name(n) for n in ast.walk(node)
+                     if isinstance(n, ast.Call)}
+            if calls & fenced:
+                fenced.add(name)
+                changed = True
+    return fenced
+
+
+def _calls_fence(node: ast.AST, fenced: set[str]) -> bool:
+    return any(isinstance(n, ast.Call)
+               and (_call_name(n) in FENCE_NAMES or _call_name(n) in fenced)
+               for n in ast.walk(node))
+
+
+def _windows(tree: ast.AST):
+    """(var, start_line, end_line) for every perf_counter window: an
+    assignment ``v = time.perf_counter()`` paired with each later readout
+    ``<expr> - v`` (covers ``perf_counter() - t0`` and the multi-split
+    ``t1 - t0`` ladder form)."""
+    assigns: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and _is_perf_counter(node.value)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            assigns.append((node.targets[0].id, node.lineno))
+    reads: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                and isinstance(node.right, ast.Name)):
+            reads.append((node.right.id, node.lineno))
+    out = []
+    for var, start in assigns:
+        ends = [ln for v, ln in reads if v == var and ln >= start]
+        # nearest readout bounds the window; later re-assignments of the
+        # same var start fresh windows (handled by taking the closest pair)
+        later_starts = [ln for v, ln in assigns if v == var and ln > start]
+        horizon = min(later_starts) if later_starts else float("inf")
+        ends = [ln for ln in ends if ln <= horizon]
+        if ends:
+            out.append((var, start, min(ends)))
+    return out
+
+
+def _nodes_in_range(tree: ast.AST, start: int, end: int):
+    for node in ast.walk(tree):
+        ln = getattr(node, "lineno", None)
+        if ln is not None and start <= ln <= end:
+            yield node
+
+
+def lint_file(path) -> list[str]:
+    """Findings (``"file:line: message"``) for one python source file."""
+    path = Path(path)
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))
+    pragmas = _pragma_lines(source)
+    fenced = _fenced_functions(tree)
+    findings: list[str] = []
+
+    def pragma_near(line: int) -> str | None:
+        # the pragma may sit on the line itself or the one above (comments
+        # above the statement read more naturally at some sites)
+        return pragmas.get(line) or pragmas.get(line - 1)
+
+    # Rule A: every window fences, or declares why it need not
+    for var, start, end in _windows(tree):
+        if pragma_near(start):
+            continue
+        if any(isinstance(n, ast.Call)
+               and (_call_name(n) in FENCE_NAMES or _call_name(n) in fenced)
+               for n in _nodes_in_range(tree, start, end)):
+            continue
+        findings.append(
+            f"{path.name}:{start}: perf_counter window on '{var}' "
+            f"(closes line {end}) has no block_until_ready/_fence and no "
+            f"'# timing:' pragma — async dispatch makes this measure "
+            f"dispatch, not compute")
+
+    # Rule B: callables passed to the timing harnesses must fence
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _call_name(node) in HARNESSES):
+            continue
+        if pragma_near(node.lineno):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        ok = False
+        if isinstance(arg, ast.Lambda):
+            ok = _calls_fence(arg, fenced)
+        elif isinstance(arg, ast.Name):
+            ok = arg.id in fenced
+        elif isinstance(arg, ast.Call):
+            ok = _call_name(arg) in fenced
+        if not ok:
+            findings.append(
+                f"{path.name}:{node.lineno}: callable passed to "
+                f"{_call_name(node)} does not (transitively) fence its "
+                f"outputs — add a _fence/block_until_ready or a "
+                f"'# timing:' pragma explaining why it is host-synchronous")
+    return findings
+
+
+def lint_paths(paths) -> list[str]:
+    findings = []
+    for p in paths:
+        findings.extend(lint_file(p))
+    return findings
+
+
+def default_targets(repo_root=None) -> list[Path]:
+    """The timing-sensitive surface: bench.py and every tools/ script
+    (this linter included — it must stay clean against itself)."""
+    root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
+    return [root / "bench.py"] + sorted((root / "tools").glob("*.py"))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    targets = [Path(a) for a in argv] or default_targets()
+    findings = lint_paths(targets)
+    for f in findings:
+        print(f)
+    print(f"lint_timing: {len(findings)} finding(s) over "
+          f"{len(targets)} file(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""Render a ``factormodeling_tpu.obs.RunReport`` JSONL as per-stage tables.
+
+Usage::
+
+    python tools/trace_report.py run_report.jsonl [more.jsonl ...]
+
+Spans aggregate by name (count / total / mean / max wall seconds, whether
+they fenced); counters, cost-analysis estimates, bench rows, and plain
+stage records print in their own sections. Pure stdlib — usable on any box
+that has the JSONL, no jax required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+__all__ = ["load_rows", "render", "main"]
+
+
+def load_rows(paths) -> list[dict]:
+    rows = []
+    for path in paths:
+        with Path(path).open() as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    return rows
+
+
+def _fmt_table(headers, rows) -> str:
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows), 1)
+              if rows else len(str(h))
+              for i, h in enumerate(headers)]
+    def line(cells):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths))
+    out = [line(headers), line("-" * w for w in widths)]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def _span_table(rows) -> str | None:
+    spans = [r for r in rows if r.get("kind") == "span"]
+    if not spans:
+        return None
+    agg: dict[str, list] = defaultdict(list)
+    fence: dict[str, str] = {}
+    for r in spans:
+        agg[r["name"]].append(float(r.get("wall_s", 0.0)))
+        # a span is sound if it fenced device outputs OR declared itself
+        # host-synchronous (its body returns host values); anything else
+        # may have timed async dispatch only
+        mark = ("yes" if r.get("fenced")
+                else "host" if r.get("sync") == "host" else "NO")
+        prev = fence.get(r["name"], mark)
+        fence[r["name"]] = prev if prev == mark else "NO"
+    body = []
+    for name, ts in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
+        body.append((name, len(ts), f"{sum(ts):.4f}",
+                     f"{sum(ts) / len(ts):.4f}", f"{max(ts):.4f}",
+                     fence[name]))
+    return ("== spans (wall seconds; fenced 'NO' means the window may have "
+            "timed dispatch only) ==\n"
+            + _fmt_table(("stage", "n", "total_s", "mean_s", "max_s",
+                          "fenced"), body))
+
+
+def _counter_table(rows) -> str | None:
+    counters = [r for r in rows if r.get("kind") == "counters"]
+    if not counters:
+        return None
+    body = []
+    for r in counters:
+        for key, val in sorted(r.get("counters", {}).items()):
+            if isinstance(val, dict):
+                val = " ".join(f"{k}={_num(v)}" for k, v in sorted(val.items()))
+            body.append((r["name"], key, val))
+    return "== device counters ==\n" + _fmt_table(
+        ("stage", "counter", "value"), body)
+
+
+def _num(v):
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return v
+
+
+def _cost_table(rows) -> str | None:
+    costs = [r for r in rows if r.get("kind") == "cost"]
+    if not costs:
+        return None
+    body = []
+    for r in costs:
+        if "error" in r:
+            body.append((r["name"], "-", "-", r["error"][:60]))
+        else:
+            body.append((r["name"], f"{r.get('flops', float('nan')):.4g}",
+                         f"{r.get('bytes_accessed', float('nan')):.4g}", ""))
+    return ("== cost analysis (XLA pre-optimization estimates) ==\n"
+            + _fmt_table(("stage", "flops", "bytes", "note"), body))
+
+
+def _stage_table(rows) -> str | None:
+    stages = [r for r in rows
+              if r.get("kind") not in ("span", "counters", "cost", "bench")]
+    if not stages:
+        return None
+    body = []
+    for r in stages:
+        extra = {k: v for k, v in r.items()
+                 if k not in ("kind", "name", "label", "meta")}
+        body.append((r.get("name", "?"),
+                     " ".join(f"{k}={_num(v)}" for k, v in sorted(extra.items()))))
+    return "== stage records ==\n" + _fmt_table(("stage", "fields"), body)
+
+
+def _bench_table(rows) -> str | None:
+    bench = [r for r in rows if r.get("kind") == "bench"]
+    if not bench:
+        return None
+    body = [(r.get("name", "?"), r.get("value", "-"), r.get("unit", "s"),
+             r.get("vs_baseline", "-"), r.get("trace_dir", "-"))
+            for r in bench]
+    return "== bench rows ==\n" + _fmt_table(
+        ("config", "value", "unit", "vs_baseline", "trace_dir"), body)
+
+
+def render(rows) -> str:
+    labels = sorted({str(r.get("label")) for r in rows if r.get("label")})
+    head = f"run report: {len(rows)} row(s)" + (
+        f", label(s): {', '.join(labels)}" if labels else "")
+    sections = [head]
+    for maker in (_span_table, _counter_table, _cost_table, _bench_table,
+                  _stage_table):
+        section = maker(rows)
+        if section:
+            sections.append(section)
+    return "\n\n".join(sections)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("jsonl", nargs="+",
+                        help="RunReport JSONL file(s) to render")
+    args = parser.parse_args(argv)
+    print(render(load_rows(args.jsonl)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
