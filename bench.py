@@ -899,6 +899,126 @@ def bench_mvo_turnover(smoke=False, profile=False):
                            polish["post_residual_p99"]})
 
 
+def bench_mvo_turnover_parallel(smoke=False, profile=False):
+    """The turnover backtest under ``turnover_mode="parallel"`` — the
+    fixed-point (Picard) execution scheme — measured against the serial
+    scan at identical settings and shape (same market, same HBM model as
+    the ``mvo_turnover`` wallclock row).
+
+    Two regimes are measured and published in one row:
+
+    - the HEADLINE config (turnover_penalty=0.1): the reference-scale L1
+      dominates the ~1e-6-scale variance curvature, the day map is
+      non-contractive (the convergence front advances one day per sweep —
+      docs/architecture.md section 14), so the sweeps stall-stop early and
+      the sequential-suffix fallback carries the run: `value` is the
+      parallel wall-clock, `vs_serial_scan` its honest (sub-1x) factor,
+      and `converged_day_frac`/`suffix_len` tell the why;
+    - the DECOUPLED config (turnover_penalty=0): the scheme's contractive
+      limit — sweeps certify in 2 and the suffix vanishes — published
+      under `decoupled` with its own serial comparison and a <= 1e-5
+      weight-parity gate.
+    """
+    d, n = (64, 64) if smoke else (1332, 1000)
+    lookback = 8 if smoke else 60
+    max_weight = 0.1 if smoke else 0.03
+
+    def pair(tp):
+        serial_s, out_s = _run_mvo_backtest(
+            d, n, lookback=lookback, max_weight=max_weight, smoke=smoke,
+            profile=False, trace_name="mvo_turnover_serial_ref", repeats=2,
+            method="mvo_turnover", qp_iters=None, turnover_penalty=tp)
+        par_s, out_p = _run_mvo_backtest(
+            d, n, lookback=lookback, max_weight=max_weight, smoke=smoke,
+            profile=profile, trace_name="mvo_turnover_parallel", repeats=2,
+            method="mvo_turnover", qp_iters=None, turnover_penalty=tp,
+            turnover_mode="parallel")
+        return serial_s, out_s, par_s, out_p
+
+    from factormodeling_tpu.backtest import sweep_stats
+
+    serial_s, out_s, par_s, out_p = pair(0.1)
+    polish = _check_mvo_invariants(out_p, d, lookback, max_weight)
+    stats = sweep_stats(out_p.diagnostics)
+    # certified-prefix parity vs the scan, on the days where both modes are
+    # at the exact optimum (polish accepted) or on the deterministic ladder
+    # (no polish attempted in either): a guard-REJECTED certified day is a
+    # budget-limited sweep-stable iterate — the same solution grade as the
+    # scan's own rejected days, but not its bitwise iterate (mvo.py
+    # docstring) — so it is excluded from the 1e-5 gate
+    # day d-1's pre-shift weights never land in the [D, N] output (the one-
+    # day execution lag), so a fully-certified prefix checks d-1 days
+    prefix = min(stats["converged_days"], d - 1)
+    if prefix:
+        p_pol = np.asarray(out_p.diagnostics.polished)[:prefix]
+        s_pol = np.asarray(out_s.diagnostics.polished)[:prefix]
+        p_att = np.isfinite(
+            np.asarray(out_p.diagnostics.polish_pre_residual))[:prefix]
+        s_att = np.isfinite(
+            np.asarray(out_s.diagnostics.polish_pre_residual))[:prefix]
+        exact = (p_pol & s_pol) | (~p_att & ~s_att)
+        rows = np.flatnonzero(exact) + 1  # pre-shift day k trades row k + 1
+        if rows.size:
+            w_p = np.nan_to_num(np.asarray(out_p.weights)[rows])
+            w_s = np.nan_to_num(np.asarray(out_s.weights)[rows])
+            # 1e-4 not 1e-5: at f32 an accepted polish from a different
+            # warm start can identify a marginal coordinate differently
+            # and land an iterate-grade ~1e-4 apart even on the same
+            # problem; the tp=0 gate below pins the 1e-5-grade agreement
+            # where the problems are warm-insensitive
+            assert np.abs(w_p - w_s).max() <= 1e-4, "certified prefix drifted"
+
+    dec_serial_s, dec_out_s, dec_par_s, dec_out_p = pair(0.0)
+    dec_stats = sweep_stats(dec_out_p.diagnostics)
+    dec_w_p = np.nan_to_num(np.asarray(dec_out_p.weights))
+    dec_w_s = np.nan_to_num(np.asarray(dec_out_s.weights))
+    dec_diff = float(np.abs(dec_w_p - dec_w_s).max())
+    # exactness rides the polish: days BOTH modes polish-accepted sit on the
+    # unique per-day optimum and must agree to 1e-5 (f32); the handful of
+    # guard-rejected days carry budget-limited iterates in both modes and
+    # may differ at iterate grade (~1e-5-1e-4, measured 1.5e-5) — published,
+    # and capped at 1e-4
+    both_acc = (np.asarray(dec_out_p.diagnostics.polished)
+                & np.asarray(dec_out_s.diagnostics.polished))[:-1]
+    acc_rows = np.flatnonzero(both_acc) + 1  # pre-shift day k trades row k+1
+    dec_diff_acc = float(np.abs(dec_w_p[acc_rows] - dec_w_s[acc_rows]).max()
+                         if acc_rows.size else 0.0)
+    assert dec_diff_acc <= 1e-5, f"decoupled parity broke: {dec_diff_acc:.2e}"
+    assert dec_diff <= 1e-4, f"decoupled rejected-day drift: {dec_diff:.2e}"
+
+    return _result(
+        f"mvo_turnover_parallel_{d}d_{n}assets_wallclock", par_s,
+        baseline_s=serial_s,
+        baseline_method="this host's own serial scan at identical settings "
+                        "(the mvo_turnover wallclock config)",
+        bytes_touched=4.0 * (5 * d * n),
+        bytes_model="compulsory panels (returns/cap/signal in, "
+                    "weights/result out); ADMM matvecs are VMEM-resident",
+        roofline_note="fixed-point scheme: O(K) batched sweeps + a "
+                      "sequential fallback for the unconverged suffix; at "
+                      "reference-scale penalties the day map is "
+                      "non-contractive and the fallback dominates "
+                      "(docs/architecture.md section 14)",
+        extras={"serial_scan_s": round(serial_s, 4),
+                "vs_serial_scan": round(serial_s / par_s, 3),
+                "sweeps": stats["sweeps"],
+                "converged_day_frac": round(stats["converged_day_frac"], 4),
+                "suffix_len": stats["suffix_len"],
+                "qp_solves": stats["qp_solves"],
+                "polish_accept_rate": round(polish["accept_rate"], 4),
+                "decoupled": {
+                    "turnover_penalty": 0.0,
+                    "value_s": round(dec_par_s, 4),
+                    "serial_scan_s": round(dec_serial_s, 4),
+                    "vs_serial_scan": round(dec_serial_s / dec_par_s, 3),
+                    "sweeps": dec_stats["sweeps"],
+                    "converged_day_frac":
+                        round(dec_stats["converged_day_frac"], 4),
+                    "suffix_len": dec_stats["suffix_len"],
+                    "max_abs_diff_vs_scan": dec_diff,
+                    "max_abs_diff_both_polished": dec_diff_acc}})
+
+
 # ------------------------------------- mvo_turnover at north-star scale
 
 
@@ -1469,6 +1589,7 @@ CONFIGS = {
     "rolling_ops": bench_rolling_ops,
     "compat_pipeline": bench_compat_pipeline,
     "mvo_turnover": bench_mvo_turnover,
+    "mvo_turnover_parallel": bench_mvo_turnover_parallel,
     "mvo_north_star": bench_mvo_north_star,
     "mvo_risk_model": bench_mvo_risk_model,
     "north_star_host": bench_north_star_host,

@@ -1,9 +1,11 @@
 """Backtest engine (L4). Reference surface: ``portfolio_simulation.py``."""
 
 from factormodeling_tpu.backtest.diagnostics import (  # noqa: F401
+    SchemeStats,
     SolverDiagnostics,
     check_anomalies,
     polish_stats,
+    sweep_stats,
 )
 from factormodeling_tpu.backtest.engine import (  # noqa: F401
     SimulationOutput,

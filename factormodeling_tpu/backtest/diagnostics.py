@@ -18,7 +18,44 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["SolverDiagnostics", "check_anomalies", "polish_stats"]
+__all__ = ["SchemeStats", "SolverDiagnostics", "check_anomalies",
+           "polish_stats", "sweep_stats"]
+
+
+class SchemeStats(NamedTuple):
+    """Per-run scalar solve-scheme telemetry (all ``int32[]``), produced by
+    the MVO weight schemes and restated on :class:`SolverDiagnostics`.
+
+    qp_solves: QP solves actually dispatched. Pad lanes do not exist (the
+      ragged chunk tail is sliced, not padded), so plain ``mvo`` and the
+      turnover scan report exactly D; the turnover-parallel scheme reports
+      seed + executed-sweep + re-solved-suffix lanes.
+    sweeps: outer Picard sweeps executed by ``turnover_mode="parallel"``
+      (0 for every other scheme — the scan runs no sweeps).
+    converged_days: length of the certified-converged trajectory prefix at
+      sweep exit (0 outside the parallel scheme).
+    suffix_len: days re-solved by the sequential fallback. The scan scheme
+      reports D (the whole run IS sequential); plain ``mvo`` reports 0.
+    """
+
+    qp_solves: jnp.ndarray
+    sweeps: jnp.ndarray
+    converged_days: jnp.ndarray
+    suffix_len: jnp.ndarray
+
+
+def sweep_stats(diag: "SolverDiagnostics") -> dict:
+    """Host-side JSON-ready view of the scheme telemetry carried on a
+    diagnostics pytree (the RunReport/bench row payload)."""
+    days = int(np.asarray(diag.active).size)
+    converged = int(np.asarray(diag.converged_days))
+    return {
+        "qp_solves": int(np.asarray(diag.qp_solves)),
+        "sweeps": int(np.asarray(diag.sweeps)),
+        "converged_days": converged,
+        "converged_day_frac": (converged / days if days else float("nan")),
+        "suffix_len": int(np.asarray(diag.suffix_len)),
+    }
 
 
 class SolverDiagnostics(NamedTuple):
@@ -40,6 +77,10 @@ class SolverDiagnostics(NamedTuple):
     polish_pre_residual / polish_post_residual: box/equality residual of
       the exit iterate before / after the polish candidate, NaN where no
       polish was attempted — ``polish_stats`` aggregates these.
+    qp_solves / sweeps / converged_days / suffix_len: scalar
+      :class:`SchemeStats` fields restated per run (defaults 0 for schemes
+      that run no solver — equal/linear — and for host-built pytrees);
+      ``sweep_stats`` summarizes them for reports.
     """
 
     primal_residual: jnp.ndarray
@@ -50,6 +91,10 @@ class SolverDiagnostics(NamedTuple):
     polished: jnp.ndarray
     polish_pre_residual: jnp.ndarray
     polish_post_residual: jnp.ndarray
+    qp_solves: jnp.ndarray | int = 0
+    sweeps: jnp.ndarray | int = 0
+    converged_days: jnp.ndarray | int = 0
+    suffix_len: jnp.ndarray | int = 0
 
 
 def polish_stats(diag: SolverDiagnostics) -> dict:

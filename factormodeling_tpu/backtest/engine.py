@@ -19,7 +19,8 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from factormodeling_tpu.backtest.diagnostics import SolverDiagnostics
+from factormodeling_tpu.backtest.diagnostics import (SchemeStats,
+                                                     SolverDiagnostics)
 from factormodeling_tpu.backtest.mvo import mvo_turnover_weights, mvo_weights
 from factormodeling_tpu.backtest.pnl import DailyResult, daily_portfolio_returns
 from factormodeling_tpu.backtest.settings import SimulationSettings
@@ -49,17 +50,19 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
     nan_d = jnp.full((d,), jnp.nan, signal.dtype)
     ok_d = jnp.ones((d,), bool)
     no_polish = (jnp.zeros((d,), bool), nan_d, nan_d)
+    # the deterministic schemes run no QP: every scheme counter stays 0
+    no_stats = SchemeStats(*(jnp.zeros((), jnp.int32) for _ in range(4)))
     with obs_stage(f"backtest/trade_list/{s.method}"):
         if s.method == "equal":
             (w, lc, sc), resid, ok = equal_weights(signal, s.pct), nan_d, ok_d
-            polish = no_polish
+            polish, stats = no_polish, no_stats
         elif s.method == "linear":
             (w, lc, sc), resid, ok = linear_weights(signal, s.max_weight), nan_d, ok_d
-            polish = no_polish
+            polish, stats = no_polish, no_stats
         elif s.method == "mvo":
-            w, lc, sc, resid, ok, polish = mvo_weights(signal, s)
+            w, lc, sc, resid, ok, polish, stats = mvo_weights(signal, s)
         else:  # mvo_turnover
-            w, lc, sc, resid, ok, polish = mvo_turnover_weights(signal, s)
+            w, lc, sc, resid, ok, polish, stats = mvo_turnover_weights(signal, s)
 
     diag = SolverDiagnostics(
         primal_residual=resid, solver_ok=ok,
@@ -67,7 +70,9 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
         short_sum=jnp.minimum(w, 0.0).sum(-1),
         active=(lc > 0) & (sc > 0),
         polished=polish[0], polish_pre_residual=polish[1],
-        polish_post_residual=polish[2])
+        polish_post_residual=polish[2],
+        qp_solves=stats.qp_solves, sweeps=stats.sweeps,
+        converged_days=stats.converged_days, suffix_len=stats.suffix_len)
 
     if s.universe is not None:
         shifted = masked_shift(w, s.universe, 1, axis=0)

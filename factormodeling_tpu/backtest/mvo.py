@@ -16,7 +16,11 @@ factored form
 which the ADMM solver consumes through a Woodbury identity (T x T inner
 Cholesky, T = lookback ~ 60). Plain ``mvo`` runs all dates through a chunked
 ``lax.map``; ``mvo_turnover`` is a ``lax.scan`` because yesterday's weights
-enter the objective (``portfolio_simulation.py:206-225``).
+enter the objective (``portfolio_simulation.py:206-225``) — or, with
+``turnover_mode="parallel"``, a fixed-point scheme that solves every day
+simultaneously over outer Picard sweeps and falls back to the exact scan
+for the unconverged suffix (:func:`_mvo_turnover_parallel`;
+docs/architecture.md section 14 has the measured regime analysis).
 
 ``SimulationSettings.covariance="risk_model"`` swaps the trailing sample
 window for a rolling statistical factor model (:mod:`factormodeling_tpu.risk`)
@@ -42,6 +46,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from factormodeling_tpu.backtest.diagnostics import SchemeStats
 from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.backtest.weights import equal_weights, leg_masks
 from factormodeling_tpu.solvers import (ADMMWarmState, BoxQPProblem,
@@ -93,7 +98,9 @@ def _shrunk_terms(c: jnp.ndarray, t_used, lam: float, dtype):
 
 def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
                s: SimulationSettings, turnover: bool, risk_model=None,
-               warm: ADMMWarmState | None = None, force_fallback=None):
+               warm: ADMMWarmState | None = None, force_fallback=None,
+               iters: int | None = None, polish: bool | None = None,
+               polish_passes: int | None = None, vvt=None):
     """One date's MVO solve with the full fallback ladder.
 
     ``risk_model``: optional ``(loadings [N, k], factor_var [k], idio [N],
@@ -120,6 +127,15 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     reference falls back (``:575-583``) — found by the round-5 QP
     differential fuzz. Plain mvo's objective is variance-only (``:399``),
     so it has no such trigger.
+
+    ``iters`` / ``polish`` / ``polish_passes`` override the settings'
+    scheme-resolved solver budget — the turnover-parallel mode runs its
+    seed and sweep stages at reduced budgets (the sequential scan and the
+    suffix fallback always use the settings defaults, keeping the exact
+    reference-semantics path untouched). ``vvt`` is the day's precomputed
+    window Gram ``C @ C.T`` for the sample-covariance path, hoisted across
+    outer sweeps (ignored under a risk model, whose Woodbury path never
+    forms it).
 
     Returns ``(w [N], primal_residual [], solver_ok [], warm_state,
     polish)`` — the residual, acceptance flag, and per-day polish telemetry
@@ -153,10 +169,16 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     # the linear/L1 terms; the ADMM solver minimizes 1/2 x'Px + ..., so P must
     # be 2 Sigma for the trade-off against the L1/return terms to match.
     prob = BoxQPProblem(q=q, lo=lo, hi=hi, E=E, b=b, l1=l1, center=center)
-    res = admm_solve_lowrank(2.0 * alpha, c, 2.0 * s_vec, prob,
-                             rho=s.qp_rho,
-                             iters=s.resolved_qp_iters(turnover),
-                             warm_start=warm, polish=s.qp_polish)
+    res = admm_solve_lowrank(
+        2.0 * alpha, c, 2.0 * s_vec, prob, rho=s.qp_rho,
+        iters=s.resolved_qp_iters(turnover) if iters is None else iters,
+        warm_start=warm,
+        polish=s.qp_polish if polish is None else polish,
+        polish_passes=polish_passes,
+        # the hoisted Gram is V@V.T; the solver consumes the SCALED V
+        # (2*alpha, c, 2*s_vec leaves V=c unscaled — scaling rides on
+        # alpha/s), so the raw window Gram passes through unchanged
+        vvt=vvt if risk_model is None else None)
     w = res.x
 
     solver_ok = (jnp.all(jnp.isfinite(w))
@@ -262,8 +284,15 @@ def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
     of ``mvo_batch`` days solve vmapped in parallel; the chunk loop is a
     ``lax.scan`` carrying each lane's ADMM exit state so day t warm-starts
     from day ``t - mvo_batch`` (the closest prior solve in its lane) —
-    disable with ``qp_warm_start=False``. Returns
-    (weights [D, N], long_count [D], short_count [D], resid, ok, polish)."""
+    disable with ``qp_warm_start=False``. A ragged tail (``d % mvo_batch``)
+    solves as a narrower final vmap instead of padding the last chunk with
+    replicas of day d-1: pad lanes used to re-solve that day up to
+    ``mvo_batch - 1`` extra times for nothing (their outputs AND their
+    carry were both discarded, so slicing is output-identical);
+    ``stats.qp_solves`` counts the lanes actually dispatched, pinned to
+    exactly D by tests. Returns
+    (weights [D, N], long_count [D], short_count [D], resid, ok, polish,
+    stats)."""
     import jax
 
     d, n = signal.shape
@@ -279,31 +308,98 @@ def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
                           warm=warm if s.qp_warm_start else None)
 
     batch = min(s.mvo_batch, d)
-    pad = (-d) % batch
-    # int32 on both halves: under x64 a bare arange is int64, and the mixed
-    # concat surfaces as an s64/s32 compare the SPMD partitioner rejects
-    days = jnp.concatenate([jnp.arange(d, dtype=jnp.int32),
-                            jnp.full((pad,), d - 1, jnp.int32)])
-    chunks = days.reshape(-1, batch)
+    full = d // batch
+    rem = d - full * batch
+    # int32 days: a bare arange is int64 under x64, and the mixed-width
+    # day-index compares fail HLO verification under SPMD partitioning
+    chunks = jnp.arange(full * batch, dtype=jnp.int32).reshape(full, batch)
 
     def chunk_step(warm, todays):
         w, resid, ok, state, polish = jax.vmap(one)(todays, warm)
         return state, (w, resid, ok, polish)
 
-    _, (w, resid, ok, polish) = lax.scan(chunk_step,
-                                         _cold_state(n, batch, dtype), chunks)
-    w = w.reshape(-1, n)[:d]
-    resid, ok = resid.reshape(-1)[:d], ok.reshape(-1)[:d]
-    polish = tuple(p.reshape(-1)[:d] for p in polish)
-    return _finalize(w, signal, s, pos, neg, flat, resid, ok, polish)
+    carry, (w, resid, ok, polish) = lax.scan(
+        chunk_step, _cold_state(n, batch, dtype), chunks)
+    w = w.reshape(-1, n)
+    resid, ok = resid.reshape(-1), ok.reshape(-1)
+    polish = tuple(p.reshape(-1) for p in polish)
+    if rem:
+        # tail lanes keep their chunk-lane warm chain: lane i of the tail
+        # warm-starts from lane i of the last full chunk, exactly as it did
+        # as a padded lane — only the pad replicas' dead solves are gone
+        tail = jnp.arange(full * batch, d, dtype=jnp.int32)
+        tail_warm = ADMMWarmState(z=carry.z[:rem], u=carry.u[:rem],
+                                  rho=carry.rho[:rem])
+        w_t, resid_t, ok_t, _, polish_t = jax.vmap(one)(tail, tail_warm)
+        w = jnp.concatenate([w, w_t])
+        resid = jnp.concatenate([resid, resid_t])
+        ok = jnp.concatenate([ok, ok_t])
+        polish = tuple(jnp.concatenate([a, b])
+                       for a, b in zip(polish, polish_t))
+    stats = SchemeStats(
+        qp_solves=jnp.asarray(full * batch + rem, jnp.int32),
+        sweeps=jnp.zeros((), jnp.int32),
+        converged_days=jnp.zeros((), jnp.int32),
+        suffix_len=jnp.zeros((), jnp.int32))
+    return _finalize(w, signal, s, pos, neg, flat, resid, ok, polish, stats)
+
+
+def _nan_signal_days(signal: jnp.ndarray, s: SimulationSettings):
+    """Days the REFERENCE's turnover solver rejects before solving (see
+    _solve_day docstring): a present (universe) cell with a NaN signal value
+    fails its cvxpy data validation on the turnover objective -> equal-x0
+    fallback day. This rejection semantics needs a universe mask to define
+    "present": ``universe=None`` declares NO mask, and dense-API callers
+    encoding absence as NaN then keep the pin-to-zero behavior (NaN signals
+    never enter a leg) instead of losing whole days to the fallback — the
+    compat layer always passes the signal's own universe, so reference
+    fidelity is unaffected."""
+    if s.universe is not None:
+        return (jnp.isnan(signal) & s.universe).any(-1)
+    return jnp.zeros(signal.shape[:-1], bool)
+
+
+def _turnover_day_solve(signal, s: SimulationSettings, stacks, zero_day,
+                        nan_sig_day, today, w_prev, warm, vvt=None,
+                        iters=None, polish_passes=None):
+    """One turnover day's solve + ladder masking — THE day step. Shared by
+    the sequential scan, the parallel sweeps, and the sequential-suffix
+    fallback so the three paths cannot drift apart semantically (the
+    fallback's bit-for-bit contract with the scan rides on this sharing);
+    the sweep/suffix-only knobs (``vvt`` hoist, reduced budgets) default
+    off for the scan."""
+    rm = None if stacks is None else _risk_model_for_day(stacks, today, s)
+    w, resid, ok, state, polish = _solve_day(
+        signal[today], s.returns, today, w_prev, s, turnover=True,
+        risk_model=rm, warm=warm if s.qp_warm_start else None,
+        force_fallback=nan_sig_day[today], vvt=vvt, iters=iters,
+        polish_passes=polish_passes)
+    w = jnp.where(zero_day[today], 0.0, w)
+    return w, resid, ok, state, polish
 
 
 def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
-    """Sequential variant: yesterday's (pre-shift) weights feed today's L1
-    turnover term (``portfolio_simulation.py:227-248``) -> ``lax.scan``.
-    The scan carry also holds the ADMM exit state (z, u, rho), so each day
-    warm-starts from yesterday's solve — the device analog of the
-    reference's scipy-path ``x0 = prev_weights`` seeding
+    """Turnover-penalized variant: yesterday's (pre-shift) weights feed
+    today's L1 turnover term (``portfolio_simulation.py:227-248``).
+
+    ``s.turnover_mode`` selects the execution scheme:
+
+    - ``"scan"`` (default): the exact reference semantics — one ``lax.scan``
+      of D dependent solves (:func:`_mvo_turnover_scan`).
+    - ``"parallel"``: the fixed-point scheme — batched outer sweeps plus a
+      sequential fallback for the unconverged suffix
+      (:func:`_mvo_turnover_parallel`).
+    """
+    if s.turnover_mode == "parallel":
+        return _mvo_turnover_parallel(signal, s)
+    return _mvo_turnover_scan(signal, s)
+
+
+def _mvo_turnover_scan(signal: jnp.ndarray, s: SimulationSettings):
+    """Sequential scheme: a ``lax.scan`` whose carry holds yesterday's
+    weights and the ADMM exit state (z, u, rho), so each day warm-starts
+    from yesterday's solve — the device analog of the reference's
+    scipy-path ``x0 = prev_weights`` seeding
     (``portfolio_simulation.py:676-680``); disable with
     ``qp_warm_start=False``."""
     d, n = signal.shape
@@ -313,29 +409,12 @@ def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
     zero_day = flat | (_universe_count(signal, s) < 2)
     stacks = _risk_model_stack(s) if s.covariance == "risk_model" else None
     dtype = s.returns.dtype
-    # the reference's NaN-signal solver rejection (see _solve_day docstring):
-    # a present (universe) cell with a NaN signal value fails its cvxpy data
-    # validation on the turnover objective -> equal-x0 fallback day. This
-    # rejection semantics needs a universe mask to define "present":
-    # ``universe=None`` declares NO mask, and dense-API callers encoding
-    # absence as NaN then keep the pin-to-zero behavior (NaN signals never
-    # enter a leg) instead of losing whole days to the fallback — the compat
-    # layer always passes the signal's own universe, so reference fidelity
-    # is unaffected.
-    if s.universe is not None:
-        nan_sig_day = (jnp.isnan(signal) & s.universe).any(-1)
-    else:
-        nan_sig_day = jnp.zeros(signal.shape[:-1], bool)
+    nan_sig_day = _nan_signal_days(signal, s)
 
     def step(carry, today):
         w_prev, warm = carry
-        rm = (None if stacks is None
-              else _risk_model_for_day(stacks, today, s))
-        w, resid, ok, state, polish = _solve_day(
-            signal[today], s.returns, today, w_prev, s, turnover=True,
-            risk_model=rm, warm=warm if s.qp_warm_start else None,
-            force_fallback=nan_sig_day[today])
-        w = jnp.where(zero_day[today], 0.0, w)
+        w, resid, ok, state, polish = _turnover_day_solve(
+            signal, s, stacks, zero_day, nan_sig_day, today, w_prev, warm)
         return (w, state), (w, resid, ok, polish)
 
     cold = _cold_state(n, 1, dtype)
@@ -344,7 +423,209 @@ def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
     # day-index compares fail HLO verification under SPMD partitioning
     _, (w, resid, ok, polish) = lax.scan(step, (jnp.zeros(n, dtype), cold),
                                          jnp.arange(d, dtype=jnp.int32))
-    return _finalize(w, signal, s, pos, neg, flat, resid, ok, polish)
+    stats = SchemeStats(
+        qp_solves=jnp.asarray(d, jnp.int32),
+        sweeps=jnp.zeros((), jnp.int32),
+        converged_days=jnp.zeros((), jnp.int32),
+        suffix_len=jnp.asarray(d, jnp.int32))
+    return _finalize(w, signal, s, pos, neg, flat, resid, ok, polish, stats)
+
+
+# per-sweep contraction floor of the parallel scheme's early stop: a sweep
+# whose trajectory delta shrank by less than this factor is not converging
+# fast enough for further sweeps to beat the sequential fallback (the
+# measured strong-coupling signature is a ratio of 0.9-1.0 — the error
+# front advancing one day per sweep — vs < 1e-3 in the contractive regime;
+# docs/architecture.md §14)
+_STALL_RATIO = 0.5
+
+
+def _mvo_turnover_parallel(signal: jnp.ndarray, s: SimulationSettings):
+    """Fixed-point (Picard) scheme for the turnover backtest — the
+    time-parallel decomposition of the sequential recurrence (parareal:
+    Lions, Maday & Turinici 2001; DEER-style fixed-point parallelization of
+    nonlinear sequential models, Lim et al. 2024):
+
+    1. seed a weight trajectory from the embarrassingly-parallel plain-MVO
+       solution (no polish — the seed only needs to be a plausible
+       ``w_prev`` trajectory and dual warm start);
+    2. run up to ``turnover_sweeps`` outer sweeps in which EVERY day's
+       turnover QP solves simultaneously (chunked ``lax.map``) against the
+       previous sweep's trajectory row, each lane warm-starting from its
+       own last-sweep exit state — a better warm start than the sequential
+       carry gets, since the lane re-solves its OWN problem with only the
+       L1 center moved;
+    3. between sweeps the fallback ladder re-propagates (``zero_day``
+       zeroing, NaN-signal force-fallback, pruning+renorm inside
+       ``_solve_day``), so the carried trajectory matches sequential
+       semantics, and the loop early-stops ON DEVICE when the trajectory
+       converges (``max_t ||w^k_t - w^{k-1}_t||_inf <= turnover_tol``) or
+       stops contracting (``_STALL_RATIO``);
+    4. the unconverged suffix — the first divergent day onward — re-solves
+       through the exact sequential scan at the settings' default budgets,
+       entering with the certified prefix's carry. With no certified prefix
+       the fallback IS the sequential scan, bit for bit.
+
+    The certificate is SWEEP-STABILITY, exactly the ISSUE's fixed-point
+    criterion: a certified day's trajectory row stopped moving under
+    re-solves. On polish-accepted days (the overwhelming majority — accept
+    rate rides the diagnostics) that means the exact QP optimum given the
+    certified predecessor; on a guard-rejected day it means a
+    budget-limited iterate that is a fixed point of its own warm re-solve
+    — the same solution grade the scan's guard-rejected days carry, but
+    not necessarily the scan's iterate, and at f32 the ladder's thresholds
+    can amplify that difference downstream (docs/architecture.md §14).
+    Exact scan-trajectory replication therefore holds when every certified
+    day is polish-exact or ladder-deterministic, and always for the
+    re-solved suffix.
+
+    The design is ``while_loop``-free (a bounded ``lax.scan`` over K sweeps
+    with a ``done`` flag; skipped sweeps cost one select) and jit/SPMD-clean.
+    Telemetry (sweeps executed, certified prefix length, suffix length, QP
+    solve count) lands in :class:`SchemeStats`.
+    """
+    import jax
+
+    d, n = signal.shape
+    pos, neg, flat = leg_masks(signal)
+    zero_day = flat | (_universe_count(signal, s) < 2)
+    nan_sig_day = _nan_signal_days(signal, s)
+    stacks = _risk_model_stack(s) if s.covariance == "risk_model" else None
+    dtype = s.returns.dtype
+    batch = min(s.mvo_batch, d)
+    days = jnp.arange(d, dtype=jnp.int32)
+    tol = jnp.asarray(s.turnover_tol, dtype)
+
+    def rm_for(today):
+        return None if stacks is None else _risk_model_for_day(stacks, today, s)
+
+    # w_prev-independent problem setup hoisted across sweeps: the [T, T]
+    # window Gram every Woodbury factorization consumes. Only the L1 center
+    # (and the warm state) changes sweep over sweep, so re-deriving the
+    # Gram per sweep would pay the one O(n T^2) setup term K+1 times.
+    # Sample-covariance path only — the risk model's vector-alpha Woodbury
+    # never forms it.
+    if stacks is None:
+        def gram_one(today):
+            c, _ = _window_factors(s.returns, today, s.lookback_period)
+            return c @ c.T
+
+        with jax.named_scope("backtest/turnover_gram"):
+            grams = lax.map(gram_one, days, batch_size=batch)
+    else:
+        grams = None
+
+    def vvt_for(today):
+        return None if grams is None else grams[today]
+
+    # ---- 1. seed trajectory: batched plain-MVO (lax.map slices the ragged
+    # tail instead of padding, like mvo_weights)
+    def seed_one(today):
+        w, _, _, state, _ = _solve_day(
+            signal[today], s.returns, today, jnp.zeros(n, dtype), s,
+            turnover=False, risk_model=rm_for(today),
+            iters=s.resolved_seed_iters(), polish=False, vvt=vvt_for(today))
+        return jnp.where(zero_day[today], 0.0, w), state
+
+    with jax.named_scope("backtest/turnover_seed"):
+        traj0, st0 = lax.map(seed_one, days, batch_size=batch)
+
+    # ---- 2./3. outer Picard sweeps with device-side early stop
+    def sweep_one(args):
+        today, w_prev_row, z, u, rho = args
+        return _turnover_day_solve(
+            signal, s, stacks, zero_day, nan_sig_day, today, w_prev_row,
+            ADMMWarmState(z=z, u=u, rho=rho), vvt=vvt_for(today),
+            iters=s.resolved_sweep_iters(),
+            polish_passes=s.turnover_polish_passes)
+
+    nan_d = jnp.full((d,), jnp.nan, dtype)
+    inf = jnp.asarray(jnp.inf, dtype)
+    carry0 = (traj0, st0.z, st0.u, st0.rho,
+              nan_d, jnp.ones((d,), bool),                    # resid, ok
+              (jnp.zeros((d,), bool), nan_d, nan_d),          # polish
+              jnp.full((d,), jnp.inf, dtype),                 # per-day delta
+              inf,                                            # last max delta
+              jnp.zeros((), bool),                            # done
+              jnp.zeros((), jnp.int32))                       # sweeps run
+
+    def sweep_body(carry, _):
+        traj, z, u, rho, resid, ok, pol, delta, dmax_prev, done, k = carry
+
+        def run(args):
+            traj, z, u, rho = args
+            w_prev_rows = jnp.concatenate(
+                [jnp.zeros((1, n), dtype), traj[:-1]], axis=0)
+            w, r2, ok2, st, pol2 = lax.map(
+                sweep_one, (days, w_prev_rows, z, u, rho), batch_size=batch)
+            delta2 = jnp.max(jnp.abs(w - traj), axis=-1)
+            return w, st.z, st.u, st.rho, r2, ok2, pol2, delta2
+
+        def skip(args):
+            return traj, z, u, rho, resid, ok, pol, delta
+
+        traj, z, u, rho, resid, ok, pol, delta = lax.cond(
+            done, skip, run, (traj, z, u, rho))
+        k = k + jnp.where(done, 0, 1).astype(jnp.int32)
+        dmax = jnp.max(delta)
+        done = done | (dmax <= tol) | (dmax > _STALL_RATIO * dmax_prev)
+        return (traj, z, u, rho, resid, ok, pol, delta, dmax, done, k), None
+
+    with jax.named_scope("backtest/turnover_sweeps"):
+        (traj, zf, uf, rhof, resid_f, ok_f, pol_f, delta, _, _, sweeps), _ = \
+            lax.scan(sweep_body, carry0, None, length=s.turnover_sweeps)
+
+    # certified prefix: every day before the first one whose trajectory row
+    # still moved more than the tolerance in the last executed sweep (the
+    # chain into a converged day is only trustworthy if ALL earlier days
+    # converged too, so the prefix — not the per-day set — is what counts)
+    bad = delta > tol
+    suffix_start = jnp.where(bad.any(), jnp.argmax(bad),
+                             jnp.asarray(d, jnp.int32)).astype(jnp.int32)
+
+    # ---- 4. sequential suffix fallback at the settings' default budgets.
+    # Prefix days pass through their certified sweep results (the runtime
+    # lax.cond skips their solves entirely); the first re-solved day enters
+    # with w_prev = the certified trajectory row and the lane's exit state.
+    cold = _cold_state(n, 1, dtype)
+    cold = ADMMWarmState(z=cold.z[0], u=cold.u[0], rho=cold.rho[0])
+
+    def suffix_step(carry, today):
+        w_prev, warm = carry
+
+        def solve(args):
+            w_prev, warm = args
+            # default (scan) budgets; the hoisted Gram is the one deviation
+            # from the scan step — admm_solve_lowrank documents the
+            # passthrough as a pure CSE-style hoist (bitwise-identical),
+            # and the adversarial exhaustion test pins the equivalence
+            return _turnover_day_solve(
+                signal, s, stacks, zero_day, nan_sig_day, today, w_prev,
+                warm, vvt=vvt_for(today))
+
+        def keep(args):
+            state = ADMMWarmState(z=zf[today], u=uf[today], rho=rhof[today])
+            return (traj[today], resid_f[today], ok_f[today], state,
+                    tuple(p[today] for p in pol_f))
+
+        w, resid, ok, state, polish = lax.cond(
+            today >= suffix_start, solve, keep, (w_prev, warm))
+        return (w, state), (w, resid, ok, polish)
+
+    with jax.named_scope("backtest/turnover_suffix"):
+        _, (w, resid, ok, polish) = lax.scan(
+            suffix_step, (jnp.zeros(n, dtype), cold), days)
+
+    d32 = jnp.asarray(d, jnp.int32)
+    stats = SchemeStats(
+        # solves actually dispatched: the seed, each executed sweep (skipped
+        # sweeps and passthrough prefix days cost nothing at runtime), and
+        # the re-solved suffix
+        qp_solves=d32 + sweeps * d32 + (d32 - suffix_start),
+        sweeps=sweeps,
+        converged_days=suffix_start,
+        suffix_len=d32 - suffix_start)
+    return _finalize(w, signal, s, pos, neg, flat, resid, ok, polish, stats)
 
 
 def _universe_count(signal: jnp.ndarray, s: SimulationSettings):
@@ -364,7 +645,7 @@ def _no_hist_days(d: int, s: SimulationSettings):
     return days == 0
 
 
-def _finalize(w, signal, s, pos, neg, flat, resid, ok, polish):
+def _finalize(w, signal, s, pos, neg, flat, resid, ok, polish, stats):
     zero_day = flat | (_universe_count(signal, s) < 2)
     w = jnp.where(zero_day[..., None], 0.0, w)
     zero = jnp.zeros_like(pos.sum(-1))
@@ -386,4 +667,4 @@ def _finalize(w, signal, s, pos, neg, flat, resid, ok, polish):
     polish = (polished & ~dead, jnp.where(dead, jnp.nan, pre),
               jnp.where(dead, jnp.nan, post))
     return (w, jnp.where(zero_day, zero, lc), jnp.where(zero_day, zero, sc),
-            resid, ok, polish)
+            resid, ok, polish, stats)

@@ -98,6 +98,49 @@ class SimulationSettings:
     # date solves cold.
     qp_warm_start: bool = dataclasses.field(default=True, metadata=dict(static=True))
 
+    # mvo_turnover execution scheme. "scan" is the exact reference
+    # semantics: a lax.scan of D dependent ADMM solves (yesterday's weights
+    # enter today's L1 objective), one day at a time. "parallel" is the
+    # fixed-point (Picard / parareal-style) scheme: seed a weight trajectory
+    # from the embarrassingly-parallel plain-MVO solution, run up to
+    # ``turnover_sweeps`` outer sweeps solving EVERY day's turnover QP
+    # simultaneously against the previous sweep's trajectory (each day's
+    # ADMM lane warm-starts from its own last-sweep exit state), stop early
+    # when the trajectory converges (max_t ||w^k_t - w^{k-1}_t||_inf <=
+    # turnover_tol, checked on device) or stalls, then fall back to the
+    # exact sequential scan for the unconverged suffix — output fidelity is
+    # never sacrificed to the sweep budget. See docs/architecture.md §14
+    # for the measured regime analysis: the scheme certifies/converges only
+    # when the L1 coupling is weak relative to the variance curvature
+    # (small turnover_penalty); at reference-scale penalties the day map is
+    # non-contractive and the suffix fallback carries the run.
+    turnover_mode: str = dataclasses.field(default="scan", metadata=dict(static=True))
+    # max outer Picard sweeps (K). The sweep loop early-stops on device when
+    # the trajectory converges or stops contracting, so K is a budget, not
+    # a cost floor.
+    turnover_sweeps: int = dataclasses.field(default=4, metadata=dict(static=True))
+    # per-day trajectory convergence tolerance (absolute, on weights —
+    # weight magnitudes are O(1/leg count), so this is conservative)
+    turnover_tol: float = 1e-6
+    # ADMM iterations per outer sweep (None -> the scheme's warm-start
+    # budget, resolved_qp_iters(turnover=True)): sweep lanes re-solve THEIR
+    # OWN problem with only the L1 center moved, a better warm start than
+    # the sequential carry gets, so smaller budgets are viable — but sweep
+    # results on certified-converged days ARE the final output, so the
+    # default stays at the scan-grade budget.
+    turnover_sweep_iters: int | None = dataclasses.field(default=None, metadata=dict(static=True))
+    # ADMM iterations for the plain-MVO seed trajectory (None -> the
+    # turnover warm budget; the seed only has to be a plausible w_prev
+    # trajectory + dual warm start, not an optimum, so it skips the polish)
+    turnover_seed_iters: int | None = dataclasses.field(default=None, metadata=dict(static=True))
+    # active-set polish passes per sweep solve (the sequential scan and the
+    # suffix fallback keep the solver default of 6): sweep re-solves start
+    # from an iterate whose active set was already identified last sweep,
+    # where 2 guarded passes match the 6-pass result (differential-tested);
+    # each skipped pass saves a refactor-sized masked Woodbury solve per
+    # day per sweep.
+    turnover_polish_passes: int = dataclasses.field(default=2, metadata=dict(static=True))
+
     def resolved_qp_iters(self, turnover: bool) -> int:
         if self.qp_iters is not None:
             return self.qp_iters
@@ -107,11 +150,25 @@ class SimulationSettings:
             return 60 if self.qp_warm_start else 100
         return 200
 
+    def resolved_sweep_iters(self) -> int:
+        """Per-sweep ADMM budget of the turnover-parallel scheme."""
+        if self.turnover_sweep_iters is not None:
+            return self.turnover_sweep_iters
+        return self.resolved_qp_iters(turnover=True)
+
+    def resolved_seed_iters(self) -> int:
+        """Plain-MVO seed budget of the turnover-parallel scheme."""
+        if self.turnover_seed_iters is not None:
+            return self.turnover_seed_iters
+        return self.resolved_qp_iters(turnover=True)
+
     def __post_init__(self):
         if self.method not in ("equal", "linear", "mvo", "mvo_turnover"):
             raise ValueError(f"Unknown method {self.method}")
         if self.covariance not in ("sample", "risk_model"):
             raise ValueError(f"Unknown covariance {self.covariance}")
+        if self.turnover_mode not in ("scan", "parallel"):
+            raise ValueError(f"Unknown turnover_mode {self.turnover_mode}")
 
     @property
     def shape(self):

@@ -27,7 +27,8 @@ from factormodeling_tpu.backtest import (
 )
 from factormodeling_tpu.backtest.diagnostics import (SolverDiagnostics,
                                                      check_anomalies,
-                                                     polish_stats)
+                                                     polish_stats,
+                                                     sweep_stats)
 from factormodeling_tpu.backtest.pnl import daily_portfolio_returns as _dense_pnl
 from factormodeling_tpu.backtest.pnl import signal_metrics as _dense_signal_metrics
 from factormodeling_tpu.compat._convert import (PanelVocab, _IdentityCache,
@@ -129,6 +130,9 @@ def _record_sim(name: str, method: str, diag: SolverDiagnostics,
         "solver_fallback_days": int((active & ~ok).sum()),
         "anomalies": n_anomalies,
         "polish": polish_stats(diag),
+        # scheme telemetry (qp_solves; the turnover-parallel sweep count,
+        # certified prefix, and sequential-suffix length land here)
+        "solver": sweep_stats(diag),
     })
     if cost is not None:
         rep.record(f"compat/sim/{name}", kind="cost", **cost)
@@ -141,19 +145,27 @@ def _fused_run_device(sig, uni, s: _DenseSettings, s_full: _DenseSettings):
     then P&L on the universe-masked weights under the full-grid settings
     (exactly the arrays the pandas weights round trip would rebuild).
 
-    Everything the host consumes per run lands in ONE packed [16, D] f32
+    Everything the host consumes per run lands in ONE packed [20, D] f32
     array, so the pandas boundary pays a single device fetch instead of
-    ~16 relay round trips (counts, six result columns, eight diagnostics)."""
+    ~20 relay round trips (counts, six result columns, eight per-day
+    diagnostics, four broadcast scheme-telemetry scalars)."""
     w, lc, sc, diag = _dense_trade_list(sig, s)
     wv = jnp.where(uni, w, jnp.nan)
     res = _dense_pnl(wv, s_full)
     f32 = sig.dtype
+    d = sig.shape[0]
+
+    def scal(v):  # scheme-telemetry scalars ride as broadcast rows
+        return jnp.broadcast_to(jnp.asarray(v, f32), (d,))
+
     packed = jnp.stack(
         [getattr(res, c) for c in _RESULT_COLUMNS]
         + [lc.astype(f32), sc.astype(f32), diag.primal_residual,
            diag.solver_ok.astype(f32), diag.long_sum, diag.short_sum,
            diag.active.astype(f32), diag.polished.astype(f32),
-           diag.polish_pre_residual, diag.polish_post_residual])
+           diag.polish_pre_residual, diag.polish_post_residual,
+           scal(diag.qp_solves), scal(diag.sweeps),
+           scal(diag.converged_days), scal(diag.suffix_len)])
     return w, res, packed
 
 
@@ -173,14 +185,20 @@ def _finalize_result(frame: pd.DataFrame, res, symbols: pd.Index,
 
 def _unpack(packed: np.ndarray):
     """(result columns dict, lc, sc, SolverDiagnostics) from the packed
-    [16, D] host array."""
+    [20, D] host array."""
     cols = {c: packed[i] for i, c in enumerate(_RESULT_COLUMNS)}
     lc, sc = packed[6], packed[7]
+
+    def scal(row):  # broadcast scheme-telemetry rows back to int scalars
+        return int(row[0]) if row.size else 0
+
     diag = SolverDiagnostics(
         primal_residual=packed[8], solver_ok=packed[9] > 0.5,
         long_sum=packed[10], short_sum=packed[11], active=packed[12] > 0.5,
         polished=packed[13] > 0.5, polish_pre_residual=packed[14],
-        polish_post_residual=packed[15])
+        polish_post_residual=packed[15],
+        qp_solves=scal(packed[16]), sweeps=scal(packed[17]),
+        converged_days=scal(packed[18]), suffix_len=scal(packed[19]))
     return cols, lc, sc, diag
 
 
@@ -217,6 +235,13 @@ class SimulationSettings:
     qp_iters: int | None = None
     qp_polish: bool = True
     mvo_batch: int = 32
+    # mvo_turnover execution scheme (compat extra; opt-in passthrough to
+    # backtest.settings — "scan" is the exact reference semantics, default;
+    # "parallel" is the fixed-point sweep scheme with sequential-suffix
+    # fallback, docs/architecture.md section 14)
+    turnover_mode: str = "scan"
+    turnover_sweeps: int = 4
+    turnover_tol: float = 1e-6
     # MVO covariance source (compat extra; the reference is sample-only):
     # "risk_model" swaps the trailing sample window for a rolling
     # statistical factor model (see backtest/settings.py)
@@ -267,6 +292,9 @@ class Simulation:
             return_weight=self.return_weight,
             qp_iters=self.qp_iters, qp_polish=self.qp_polish,
             mvo_batch=self.mvo_batch,
+            turnover_mode=self.turnover_mode,
+            turnover_sweeps=self.turnover_sweeps,
+            turnover_tol=self.turnover_tol,
             covariance=self.covariance, risk_factors=self.risk_factors,
             risk_lookback=self.risk_lookback,
             risk_refit_every=self.risk_refit_every)

@@ -75,6 +75,14 @@ class StageCounters(NamedTuple):
       countable).
     polish_attempted / polish_accepted: ``int32[]`` — active-set polish
       candidacy and guarded acceptance (see ``SolverDiagnostics``).
+    qp_solves: ``int32[]`` — QP solves actually dispatched by the weight
+      scheme (pad lanes are sliced away, so plain mvo / the turnover scan
+      report exactly D — the solve-count pin for the ragged-tail fix).
+    turnover_sweeps / turnover_converged_days / turnover_suffix_len:
+      ``int32[]`` — the turnover-parallel scheme's outer-sweep telemetry
+      (executed Picard sweeps, certified-converged prefix length,
+      sequential-fallback suffix length; see
+      ``backtest.diagnostics.SchemeStats``).
     """
 
     universe_size: jnp.ndarray
@@ -87,6 +95,10 @@ class StageCounters(NamedTuple):
     solver_fallback_days: jnp.ndarray
     polish_attempted: jnp.ndarray
     polish_accepted: jnp.ndarray
+    qp_solves: jnp.ndarray
+    turnover_sweeps: jnp.ndarray
+    turnover_converged_days: jnp.ndarray
+    turnover_suffix_len: jnp.ndarray
 
 
 def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
@@ -132,6 +144,10 @@ def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
         polish_attempted=jnp.isfinite(
             diag.polish_pre_residual).sum().astype(jnp.int32),
         polish_accepted=diag.polished.sum().astype(jnp.int32),
+        qp_solves=jnp.asarray(diag.qp_solves, jnp.int32),
+        turnover_sweeps=jnp.asarray(diag.sweeps, jnp.int32),
+        turnover_converged_days=jnp.asarray(diag.converged_days, jnp.int32),
+        turnover_suffix_len=jnp.asarray(diag.suffix_len, jnp.int32),
     )
 
 
@@ -157,4 +173,8 @@ def summarize_counters(counters: StageCounters) -> dict:
         "solver_fallback_days": int(c["solver_fallback_days"]),
         "polish_attempted": int(c["polish_attempted"]),
         "polish_accepted": int(c["polish_accepted"]),
+        "qp_solves": int(c["qp_solves"]),
+        "turnover_sweeps": int(c["turnover_sweeps"]),
+        "turnover_converged_days": int(c["turnover_converged_days"]),
+        "turnover_suffix_len": int(c["turnover_suffix_len"]),
     }

@@ -217,7 +217,8 @@ def _reduced_kkt_solve(mv, masked_solver, prob: BoxQPProblem, q, m, xa, qt):
     return xa + m * (y + dy), nu + dnu
 
 
-def _polish_candidate(mv, masked_solver, prob: BoxQPProblem, q, l1, z):
+def _polish_candidate(mv, masked_solver, prob: BoxQPProblem, q, l1, z,
+                      passes: int = _POLISH_PASSES):
     """Active-set KKT refinement candidate (OSQP paper section 5.2), batched
     and fixed-shape.
 
@@ -377,7 +378,7 @@ def _polish_candidate(mv, masked_solver, prob: BoxQPProblem, q, l1, z):
     k = prob.b.shape[-1]
     best0 = (big, big, jnp.zeros(n, dtype), jnp.zeros(k, dtype))
     _, _, _, _, best = lax.fori_loop(
-        0, _POLISH_PASSES, one_pass, (at_lo, at_hi, at_kink, side, best0))
+        0, passes, one_pass, (at_lo, at_hi, at_kink, side, best0))
     return best[2], best[3]
 
 
@@ -395,7 +396,8 @@ def _unroll_factor() -> int:
 
 
 def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
-                     relax, warm=None, polish_ops=None):
+                     relax, warm=None, polish_ops=None,
+                     polish_passes: int = _POLISH_PASSES):
     """Shared ADMM loop with residual-balanced adaptive rho.
 
     ``make_solver(rho)`` returns a function applying (P + rho I)^{-1}; it is
@@ -534,7 +536,8 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
         else:
             with jax.named_scope("solver/polish"):
                 mv, masked_solver = polish_ops
-                x_p, nu = _polish_candidate(mv, masked_solver, prob, q, l1, z)
+                x_p, nu = _polish_candidate(mv, masked_solver, prob, q, l1, z,
+                                            passes=polish_passes)
 
                 # Guarded acceptance, mirroring OSQP's: the polished point
                 # must be (a) no less feasible than the exit x and (b) no
@@ -565,14 +568,19 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
 def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
                      iters: int = 500, relax: float = 1.7,
                      warm_start: ADMMWarmState | None = None,
-                     polish: bool = True) -> ADMMResult:
+                     polish: bool = True,
+                     polish_passes: int | None = None) -> ADMMResult:
     """Dense-P path (small n: factor-selection MVO). P must be symmetric PSD.
 
     ``rho`` is the initial penalty; residual balancing adapts it every
     ``_ADAPT_EVERY`` iterations. Exactly ``iters`` iterations run.
     ``warm_start`` seeds (z, u, rho) from a previous related solve
     (``ADMMResult.warm_state``). ``polish`` runs the guarded active-set KKT
-    refinement at exit (one extra masked Cholesky solve)."""
+    refinement at exit (one extra masked Cholesky solve). ``polish_passes``
+    overrides the default ``_POLISH_PASSES`` active-set refinement budget —
+    warm re-solves of an already-identified problem (the turnover-parallel
+    sweep lanes) accept from 1-2 passes, and each pass is a
+    refactor-sized masked solve worth skipping."""
     n = P.shape[-1]
     scale = jnp.maximum(jnp.trace(P) / n, 1e-12)
     Ps = P / scale
@@ -595,14 +603,18 @@ def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
 
     return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax,
                             warm=warm_start,
-                            polish_ops=(mv, masked_solver) if polish else None)
+                            polish_ops=(mv, masked_solver) if polish else None,
+                            polish_passes=(_POLISH_PASSES if polish_passes
+                                           is None else int(polish_passes)))
 
 
 def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
                        prob: BoxQPProblem, *, rho: float = 2.0,
                        iters: int = 500, relax: float = 1.7,
                        warm_start: ADMMWarmState | None = None,
-                       polish: bool = True) -> ADMMResult:
+                       polish: bool = True,
+                       polish_passes: int | None = None,
+                       vvt: jnp.ndarray | None = None) -> ADMMResult:
     """Low-rank path: P = diag(alpha) + V' diag(s) V with V: [T, n], T << n.
 
     ``alpha`` is a scalar (the backtest's shrinkage/jitter identity,
@@ -620,7 +632,15 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
     refinement at exit; its reduced solve rides the same Woodbury identity
     with masked V columns and the active coordinates decoupled on the
     diagonal, so it stays O(nT + T^3) — one extra "refactor"-sized solve per
-    problem, paid once, not per iteration.
+    problem, paid once, not per iteration. ``polish_passes`` overrides the
+    default refinement budget (see :func:`admm_solve_dense`).
+
+    ``vvt``: optional precomputed ``V @ V.T`` (scalar-alpha path only,
+    ignored for a vector alpha). The turnover-parallel mode re-solves every
+    day's problem once per outer sweep with only the L1 center moving, so
+    hoisting this [T, T] Gram across sweeps removes the one O(n T^2) term
+    from the per-sweep setup. Passing the same product the solver would
+    compute is a pure CSE-style hoist — bitwise-identical results.
     """
     t, n = V.shape
     alpha = jnp.asarray(alpha)
@@ -634,7 +654,7 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
     ss_safe = jnp.where(ss > 0, ss, 1.0)
     inv_ss = jnp.diag(jnp.where(ss > 0, 1.0 / ss_safe, 1e12))
     vector_alpha = alpha.ndim == 1                   # static at trace time
-    if not vector_alpha:
+    if not vector_alpha and vvt is None:
         vvt = V @ V.T                                # [T, T], factored once
 
     def make_solver(rho):
@@ -676,4 +696,6 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
 
     return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax,
                             warm=warm_start,
-                            polish_ops=(mv, masked_solver) if polish else None)
+                            polish_ops=(mv, masked_solver) if polish else None,
+                            polish_passes=(_POLISH_PASSES if polish_passes
+                                           is None else int(polish_passes)))

@@ -390,7 +390,7 @@ def test_risk_model_day_matches_direct_optimal_weights(rng):
     s = settings_for(returns, cap, invest, method="mvo",
                      covariance="risk_model", risk_factors=3,
                      risk_lookback=lb, risk_refit_every=cad, max_weight=0.4)
-    w, lc, sc, resid, ok, _polish = mvo_weights(jnp.array(signal), s)
+    w, lc, sc, resid, ok, _polish, _stats = mvo_weights(jnp.array(signal), s)
 
     today = 3 * cad + 2  # block 3: fit on rows [8, 24)
     model = risk.statistical_risk_model(

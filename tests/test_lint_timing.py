@@ -14,9 +14,19 @@ import lint_timing  # noqa: E402
 
 
 def test_repo_timing_surface_is_clean():
-    """bench.py and every tools/ script pass both rules — the actual gate."""
+    """bench.py, every tools/ script, and the backtest/solver modules pass
+    both rules — the actual gate."""
     findings = lint_timing.lint_paths(lint_timing.default_targets(REPO))
     assert findings == []
+
+
+def test_default_targets_cover_the_sweep_loop_driver():
+    """The turnover-parallel outer-sweep driver (backtest/mvo.py) and the
+    solver it drives are part of the linted surface — an unfenced
+    host-timing window in the iteration driver would time async dispatch,
+    exactly the bug class this lint exists for."""
+    names = {p.name for p in lint_timing.default_targets(REPO)}
+    assert {"mvo.py", "engine.py", "admm_qp.py", "bench.py"} <= names
 
 
 def _lint_snippet(tmp_path, code):

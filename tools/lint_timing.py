@@ -206,10 +206,18 @@ def lint_paths(paths) -> list[str]:
 
 
 def default_targets(repo_root=None) -> list[Path]:
-    """The timing-sensitive surface: bench.py and every tools/ script
-    (this linter included — it must stay clean against itself)."""
+    """The timing-sensitive surface: bench.py, every tools/ script (this
+    linter included — it must stay clean against itself), and the backtest
+    driver + solver modules. The latter joined with the turnover-parallel
+    outer-sweep loop (round 8): an iteration driver is exactly where an
+    unfenced host-timing window would be tempting to add and wrong — its
+    sweeps dispatch asynchronously — so the sweep-loop code path stays
+    under rule A permanently."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
-    return [root / "bench.py"] + sorted((root / "tools").glob("*.py"))
+    pkg = root / "factormodeling_tpu"
+    return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))
+            + sorted((pkg / "backtest").glob("*.py"))
+            + sorted((pkg / "solvers").glob("*.py")))
 
 
 def main(argv=None) -> int:

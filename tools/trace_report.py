@@ -122,11 +122,21 @@ def _bench_table(rows) -> str | None:
     bench = [r for r in rows if r.get("kind") == "bench"]
     if not bench:
         return None
+    # scheme telemetry the turnover-parallel row publishes (sweep count,
+    # certified-converged fraction, sequential-fallback length, its own
+    # serial comparison) renders inline so the regime is readable from the
+    # table alone
+    extra_keys = ("vs_serial_scan", "sweeps", "converged_day_frac",
+                  "suffix_len")
     body = [(r.get("name", "?"), r.get("value", "-"), r.get("unit", "s"),
-             r.get("vs_baseline", "-"), r.get("trace_dir", "-"))
+             r.get("vs_baseline", "-"),
+             " ".join(f"{k}={_num(r[k])}" for k in extra_keys if k in r)
+             or "-",
+             r.get("trace_dir", "-"))
             for r in bench]
     return "== bench rows ==\n" + _fmt_table(
-        ("config", "value", "unit", "vs_baseline", "trace_dir"), body)
+        ("config", "value", "unit", "vs_baseline", "scheme", "trace_dir"),
+        body)
 
 
 def render(rows) -> str:
