@@ -1442,6 +1442,78 @@ def bench_compat_pipeline(smoke=False, profile=False):
 
 
 
+# -------------------------------------- obs: numerics-probe overhead gate
+
+
+def bench_obs_overhead(smoke=False, profile=False):
+    """Numerics-probe overhead of the jitted research step at the same
+    12f x 504d x 200n shape the StageCounters overhead was published at
+    (docs/architecture.md section 13): probes-off vs probes-on, interleaved
+    min-of-N so both see the same noise environment. The probes are
+    reductions over arrays the step already materializes, so the
+    acceptance bound is 2% (asserted at full shape before the row
+    publishes); probes-off is bit-identical by the elision contract
+    (tier-1 differential in tests/test_obs.py), so production pays zero.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from factormodeling_tpu.parallel import build_research_step
+
+    f, d, n = (4, 40, 24) if smoke else (12, 504, 200)
+    rng = np.random.default_rng(7)
+    factors = rng.normal(size=(f, d, n)).astype(np.float32)
+    factors[rng.uniform(size=factors.shape) < 0.04] = np.nan
+    names = tuple(f"fac{i}_flx" for i in range(f))
+    args = tuple(jnp.asarray(a) for a in (
+        factors,
+        rng.normal(scale=0.02, size=(d, n)).astype(np.float32),
+        rng.normal(scale=0.01, size=(d, f)).astype(np.float32),
+        rng.integers(1, 4, size=(d, n)).astype(np.float32),
+        np.ones((d, n), np.float32),
+        rng.uniform(size=(d, n)) > 0.05,
+    ))
+    step_off = jax.jit(build_research_step(names=names, window=20,
+                                           collect_counters=False,
+                                           collect_probes=False))
+    step_on = jax.jit(build_research_step(names=names, window=20,
+                                          collect_counters=False,
+                                          collect_probes=True))
+
+    out_off = step_off(*args)   # compile + warm
+    out_on = step_on(*args)
+    jax.block_until_ready((out_off, out_on))
+    # probes-on numerics equivalence: instrumentation must not move numbers
+    np.testing.assert_array_equal(np.asarray(out_off.signal),
+                                  np.asarray(out_on.signal))
+    assert out_on.probes is not None and out_off.probes is None
+
+    reps = 5 if smoke else 20
+    t_off, t_on = [], []
+    with _profiled(profile, "obs_overhead"):
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step_off(*args))
+            t_off.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            jax.block_until_ready(step_on(*args))
+            t_on.append(time.perf_counter() - t0)
+    overhead = min(t_on) / min(t_off) - 1.0
+    if not smoke:
+        assert overhead <= 0.02, (
+            f"probe overhead {overhead:.2%} exceeds the 2% acceptance "
+            f"bound (off {min(t_off):.4f}s on {min(t_on):.4f}s)")
+    return _result(
+        f"obs_probe_overhead_{f}f_{d}d_{n}assets", min(t_on),
+        roofline_note="overhead gate, not a throughput row: probes ride "
+                      "reductions over tensors the step already "
+                      "materializes",
+        extras={"seconds_probes_off": round(min(t_off), 4),
+                "probe_overhead_frac": round(overhead, 4),
+                "acceptance": "probe_overhead_frac <= 0.02",
+                "probe_stages": len(out_on.probes)})
+
+
 # --------------------------------------------- north star from DISK chunks
 
 
@@ -1587,6 +1659,7 @@ CONFIGS = {
     "risk_model": bench_risk_model,
     "sweep": bench_sweep,
     "rolling_ops": bench_rolling_ops,
+    "obs_overhead": bench_obs_overhead,
     "compat_pipeline": bench_compat_pipeline,
     "mvo_turnover": bench_mvo_turnover,
     "mvo_turnover_parallel": bench_mvo_turnover_parallel,

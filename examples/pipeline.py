@@ -273,9 +273,17 @@ def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
 
         store.save_frame("com_factors_df", com_factors_df)  # cell 50
     if report_path is not None:
+        # process-wide compile totals + per-entry-point retrace verdicts —
+        # the compat kernels' compile rows land during the run; this row
+        # closes the report with the aggregate
+        report.record("compile/totals", kind="stage",
+                      **obs.compile_totals(),
+                      retraced=sorted(n for n, s in obs.compile_stats().items()
+                                      if s["retraced"]))
         path = report.write_jsonl(report_path)
         say(f"run report: {path} "
-            f"(render: python tools/trace_report.py {path})")
+            f"(render: python tools/trace_report.py {path}; gate vs a "
+            f"baseline: python tools/report_diff.py <baseline> {path})")
     return out
 
 

@@ -63,11 +63,12 @@ def run_notebook(notebook: str | Path, workdir: str | Path, *,
     cwd = os.getcwd()
     os.chdir(workdir)
     ns: dict = {"__name__": "__main__"}
+    # timing: host-sync (compat cells materialize pandas outputs per cell)
     t_start = time.perf_counter()
     try:
         for i, cell in enumerate(cells):
             src = "".join(cell["source"])
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # timing: host-sync (pandas cell outputs)
             exec(compile(src, f"<pipeline.ipynb cell {i}>", "exec"), ns)
             plt.close("all")
             head = next((ln for ln in src.splitlines() if ln.strip()), "")

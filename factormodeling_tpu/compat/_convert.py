@@ -206,8 +206,27 @@ def jit_kernel(fn, **jit_kw):
     if hit is None:
         import jax
 
-        hit = _JIT_CACHE[key] = jax.jit(fn, **{
-            k2: v for k2, v in jit_kw.items()})
+        from factormodeling_tpu.obs.compile_log import (entry_point_tag,
+                                                        instrument_jit)
+
+        # compile telemetry per compat kernel: the cache's whole point is
+        # one trace per static tuple, so the instrumented wrapper's retrace
+        # detector flags any regression of that guarantee. The name carries
+        # a stable tag of the WHOLE cache key — code location included,
+        # since distinct call-site lambdas share '<lambda>' and may share
+        # closure values — so no two genuinely different kernels pool
+        # their compile counts into a phantom retrace.
+        code = key[0]
+        kw = dict(jit_kw)
+        hit = _JIT_CACHE[key] = instrument_jit(
+            jax.jit(fn, **kw),
+            f"compat/jit/{getattr(fn, '__name__', 'kernel')}/"
+            f"{entry_point_tag((code.co_filename, code.co_firstlineno), key[1], key[2])}",
+            # static args (e.g. the group ops' n_groups) recompile per
+            # value by design — the detector must count them as
+            # signatures, not retraces
+            static_argnums=kw.get("static_argnums", ()),
+            static_argnames=kw.get("static_argnames", ()))
     return hit
 
 

@@ -1,7 +1,9 @@
 """Runtime observability: named-scope tracing, device-side stage counters,
-and the structured run report.
+numerics probes, compile telemetry, and the structured run report with
+regression gating.
 
-Three tools, one per time domain (docs/architecture.md section 13):
+The collection tools, one per time domain (docs/architecture.md
+sections 13 and 15):
 
 - :mod:`~factormodeling_tpu.obs.trace` — ``obs.stage(name)`` pushes
   human-readable stage names into HLO op metadata so profiler traces and
@@ -11,11 +13,23 @@ Three tools, one per time domain (docs/architecture.md section 13):
   (universe coverage, NaN share, selection churn, solver/polish tallies),
   with trace-time structural elision when disabled: outputs stay
   bit-identical to an uninstrumented build.
+- :mod:`~factormodeling_tpu.obs.probes` — ``probe(name, x)`` on-device
+  tensor summaries (finite fraction, NaN/Inf counts, absmax, mean/std,
+  log2-magnitude histogram) under the same trace-time elision gate, with
+  a host-side :func:`~factormodeling_tpu.obs.probes.watchdog` that
+  pinpoints the first stage whose finite fraction dropped — NaN
+  provenance from the report alone.
+- :mod:`~factormodeling_tpu.obs.compile_log` — a ``jax.monitoring``
+  compile listener plus :func:`instrument_jit` wrappers at the jit entry
+  points: per-entry-point compile seconds/counts as report rows and a
+  silent-retrace detector.
 - :mod:`~factormodeling_tpu.obs.report` — ``obs.span(...)`` wall timers
   with built-in ``block_until_ready`` fences, and :class:`RunReport`,
-  which merges spans, counter summaries, ``polish_stats``, and
-  ``cost_analysis()`` FLOP/byte estimates into one JSONL artifact
-  (rendered by ``tools/trace_report.py``).
+  which merges spans, counter summaries, probe frames, compile rows,
+  ``polish_stats``, and ``cost_analysis()`` FLOP/byte estimates into one
+  JSONL artifact (rendered by ``tools/trace_report.py``; two reports diff
+  and gate via :mod:`~factormodeling_tpu.obs.regression` /
+  ``tools/report_diff.py``).
 
 Quickstart::
 
@@ -33,6 +47,13 @@ Quickstart::
     rep.write_jsonl("run_report.jsonl")
 """
 
+from factormodeling_tpu.obs import regression  # noqa: F401
+from factormodeling_tpu.obs.compile_log import (  # noqa: F401
+    InstrumentedJit,
+    compile_stats,
+    compile_totals,
+    instrument_jit,
+)
 from factormodeling_tpu.obs.counters import (  # noqa: F401
     StageCounters,
     collecting,
@@ -40,6 +61,15 @@ from factormodeling_tpu.obs.counters import (  # noqa: F401
     enable_counters,
     stage_counters,
     summarize_counters,
+)
+from factormodeling_tpu.obs.probes import (  # noqa: F401
+    ProbeFrame,
+    enable_probes,
+    probe,
+    probes_enabled,
+    probing,
+    summarize_probes,
+    watchdog,
 )
 from factormodeling_tpu.obs.report import (  # noqa: F401
     RunReport,

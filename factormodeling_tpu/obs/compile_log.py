@@ -1,0 +1,319 @@
+"""Compile-time telemetry: per-entry-point compile seconds, compilation
+counts, and a silent-retrace detector.
+
+JAX compiles lazily and silently: the first call of a jit at a new shape
+signature pays seconds of XLA time, and a *retrace storm* — a fresh jit
+wrapper per call, an unhashable static, a shape-unstable caller — turns a
+per-call hot path into a permanent recompilation loop whose only symptom
+is "the pipeline got slow". (``parallel/streaming.py``'s kernel cache
+exists because exactly this was measured: 8.6 s -> 195 s on the north-star
+pass when the per-chunk jits were per-call lambdas.) This module makes
+compilation a first-class observable:
+
+- a process-wide ``jax.monitoring`` event-duration listener (jax >= 0.4.x
+  emits ``/jax/core/compile/{jaxpr_trace,jaxpr_to_mlir_module,
+  backend_compile}_duration``) aggregates global trace/lowering/compile
+  seconds (:func:`compile_totals`);
+- :func:`instrument_jit` wraps one jit entry point: every call that
+  triggered a compile is attributed to the entry point's name, recorded as
+  a ``kind="compile"`` row on the active
+  :class:`~factormodeling_tpu.obs.report.RunReport`, and checked by the
+  retrace detector — an entry point whose cumulative compile count exceeds
+  its *expected signature count* (by default the number of distinct
+  (shape, dtype) call signatures seen; pass ``expected_signatures`` to pin
+  it) is flagged ``retraced``.
+
+Attribution is by call window (single-threaded pipelines: any compile
+event that fires during the wrapped call belongs to it), which is how the
+library's own entry points are wired: the sharded research step
+(``make_sharded_research_step``), the streaming per-chunk kernels
+(``_cached_kernel``), and the compat layer's cached op kernels
+(``compat/_convert.jit_kernel``). Wrap your own with
+``obs.instrument_jit(jax.jit(step), "research_step")``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from factormodeling_tpu.obs.report import record_stage
+
+__all__ = ["InstrumentedJit", "compile_stats", "compile_totals",
+           "entry_point_tag", "install", "instrument_jit",
+           "reset_compile_stats"]
+
+_BACKEND = "/jax/core/compile/backend_compile_duration"
+_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+_LOWER = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+
+# process-wide aggregates; "compiles" counts backend compilations (the
+# expensive XLA step — one per executable actually built)
+_totals = {"compiles": 0, "compile_s": 0.0, "trace_s": 0.0, "lower_s": 0.0}
+_installed = False
+#: name -> accumulated per-entry-point stats. Holds STATS ONLY, never the
+#: wrapped callables: an evicted/abandoned kernel must be garbage-
+#: collectable (the streaming LRU exists to bound executable memory), and
+#: every wrapper under one name mutates the same record — which is also
+#: what makes the fresh-wrapper-per-call retrace storm visible as a
+#: compile count that grows while the signature set stands still.
+_REGISTRY: "dict[str, _EntryPointStats]" = {}
+
+
+class _EntryPointStats:
+    """Mutable accumulator shared by every wrapper under one name."""
+
+    __slots__ = ("calls", "compiles", "compile_s", "signatures",
+                 "expected_signatures")
+
+    def __init__(self):
+        self.calls = 0
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.signatures: set = set()
+        self.expected_signatures: "int | None" = None
+
+    @property
+    def retraces(self) -> int:
+        expected = (self.expected_signatures
+                    if self.expected_signatures is not None
+                    else len(self.signatures))
+        return max(self.compiles - expected, 0)
+
+    def as_dict(self) -> dict:
+        return {
+            "calls": self.calls,
+            "compiles": self.compiles,
+            "compile_s": round(self.compile_s, 6),
+            "signatures": len(self.signatures),
+            "expected_signatures": self.expected_signatures,
+            "retraces": self.retraces,
+            "retraced": self.retraces > 0,
+        }
+
+
+def _listener(event: str, duration: float, **_kw) -> None:
+    if event == _BACKEND:
+        _totals["compiles"] += 1
+        _totals["compile_s"] += duration
+    elif event == _TRACE:
+        _totals["trace_s"] += duration
+    elif event == _LOWER:
+        _totals["lower_s"] += duration
+
+
+def install() -> bool:
+    """Idempotently register the monitoring listener; returns whether the
+    environment supports it (no-op False on a jax without
+    ``jax.monitoring``)."""
+    global _installed
+    if _installed:
+        return True
+    mon = getattr(jax, "monitoring", None)
+    if mon is None or not hasattr(mon,
+                                  "register_event_duration_secs_listener"):
+        return False  # pragma: no cover - older/newer jax without the API
+    mon.register_event_duration_secs_listener(_listener)
+    _installed = True
+    return True
+
+
+def compile_totals() -> dict:
+    """Process-wide compile aggregates since import:
+    ``{"compiles", "compile_s", "trace_s", "lower_s"}`` (backend
+    compilations / seconds, tracing seconds, StableHLO lowering seconds).
+    """
+    return {k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in _totals.items()}
+
+
+def compile_stats() -> dict:
+    """Per-entry-point snapshot: ``{name: {calls, compiles, compile_s,
+    signatures, expected_signatures, retraces, retraced}}`` for every
+    :func:`instrument_jit` entry point seen in this process."""
+    return {name: st.as_dict() for name, st in _REGISTRY.items()}
+
+
+def reset_compile_stats() -> None:
+    """Forget every per-entry-point record. The process-wide totals keep
+    counting; already-live wrappers keep mutating their (now detached)
+    records, and newly created wrappers start fresh."""
+    _REGISTRY.clear()
+
+
+def entry_point_tag(*parts) -> str:
+    """A short, RUN-STABLE tag distinguishing entry-point variants that
+    share a human name (e.g. two streaming kernel configs of one kind).
+
+    Stats accumulate per NAME (see :class:`InstrumentedJit`), so two
+    genuinely different jits under one name would read as a retrace storm
+    — one legitimate compile each, same signatures. Appending this tag
+    keeps them separate while keeping the storm visible: the tag is built
+    from STABLE identity only (callables contribute their ``__qualname__``,
+    never their id/repr address), so the storm's fresh-lambda-per-call
+    sources all map to ONE tag and keep accumulating under it."""
+    import hashlib
+
+    import re
+
+    def stable(x):
+        if isinstance(x, (tuple, list)):
+            return "(" + ",".join(stable(v) for v in x) + ")"
+        if callable(x):
+            return getattr(x, "__qualname__", None) or type(x).__name__
+        # default object reprs embed the instance address — strip it, or
+        # every fresh object mints a fresh tag (splitting a storm across
+        # per-call names and growing the registry without bound)
+        return re.sub(r" at 0x[0-9a-fA-F]+", "", repr(x))
+
+    joined = ";".join(stable(p) for p in parts)
+    return hashlib.blake2s(joined.encode()).hexdigest()[:6]
+
+
+#: signature-set size cap: a pathological caller (every call a new shape)
+#: stops growing the set here; compiles keep counting past it, so the
+#: storm still flags as retraced instead of leaking memory forever
+_MAX_SIGNATURES = 4096
+
+
+def _leaf_sig(x):
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(x, (bool, int, float, complex)) or x is None:
+        return ("scalar", type(x).__name__)
+    try:
+        hash(x)
+        return ("val", x)
+    except TypeError:
+        return ("obj", type(x).__name__)
+
+
+def _tree_sig(a):
+    leaves, treedef = jax.tree_util.tree_flatten(a)
+    return (tuple(_leaf_sig(leaf) for leaf in leaves), str(treedef))
+
+
+def _signature(args, kwargs, static_argnums=(), static_argnames=()) -> tuple:
+    """Hashable (shape, dtype) signature of a call — the key whose distinct
+    count a healthy jit's compile count matches. Python scalars key by
+    TYPE, not value (jit abstracts them to dtype, so distinct values share
+    one compilation and must share one signature) — EXCEPT arguments the
+    wrapped jit declared static (``static_argnums``/``static_argnames``),
+    which legitimately recompile per value and key by value; other
+    hashables (would-be statics: strings, enums, tuples) key by value;
+    unhashables by type name. Every rule keeps the signature count from
+    either tracking call count (which would blind the retrace detector)
+    or undercounting legitimate compilations (which would cry wolf)."""
+    parts = []
+    for i, a in enumerate(args):
+        parts.append(("static", repr(a)) if i in static_argnums
+                     else _tree_sig(a))
+    for k in sorted(kwargs):
+        parts.append((k, ("static", repr(kwargs[k]))
+                      if k in static_argnames else _tree_sig(kwargs[k])))
+    return tuple(parts)
+
+
+class InstrumentedJit:
+    """A jit entry point with compile telemetry (see module docs).
+
+    Transparent: calls forward to the wrapped callable and every other
+    attribute (``lower``, ``_cache_size``, ...) resolves on it, so the
+    wrapper drops into existing call sites. Telemetry rows
+    (``kind="compile"``) are recorded into the active RunReport only on
+    calls that actually compiled — steady-state calls add two dict reads
+    and one (shape, dtype) tuple build.
+    """
+
+    def __init__(self, fn, name: str,
+                 expected_signatures: int | None = None,
+                 static_argnums=(), static_argnames=()):
+        install()
+        self._fn = fn
+        self.name = name
+        def norm(v):  # jax accepts a bare int/str here; normalize
+            return (v,) if isinstance(v, (int, str)) else tuple(v or ())
+
+        self._static_argnums = norm(static_argnums)
+        self._static_argnames = norm(static_argnames)
+        # stats ACCUMULATE across wrappers of the same name, through the
+        # registry's shared record: the library's re-wrap sites
+        # (streaming's kernel cache, compat's jit cache) build a fresh
+        # wrapper per cache MISS, and the retrace storm this module exists
+        # to catch is exactly "fresh jit per call" — per-wrapper-fresh
+        # stats would reset to compiles=1/signatures=1 every time and
+        # never flag it. (Genuinely different jits must therefore NOT
+        # share a name — append an entry_point_tag of their config.)
+        self._stats = _REGISTRY.setdefault(name, _EntryPointStats())
+        if expected_signatures is not None:
+            self._stats.expected_signatures = expected_signatures
+
+    def __call__(self, *args, **kwargs) -> Any:
+        n0, s0 = _totals["compiles"], _totals["compile_s"]
+        out = self._fn(*args, **kwargs)
+        st = self._stats
+        st.calls += 1
+        if len(st.signatures) < _MAX_SIGNATURES:
+            try:
+                st.signatures.add(_signature(args, kwargs,
+                                             self._static_argnums,
+                                             self._static_argnames))
+            except Exception:  # exotic args never break the call path
+                st.signatures.add(("unsignable",))
+        new = _totals["compiles"] - n0
+        if new:
+            st.compiles += new
+            st.compile_s += _totals["compile_s"] - s0
+            record_stage(self.name, kind="compile", **st.as_dict())
+        return out
+
+    @property
+    def calls(self) -> int:
+        return self._stats.calls
+
+    @property
+    def compiles(self) -> int:
+        return self._stats.compiles
+
+    @property
+    def compile_s(self) -> float:
+        return self._stats.compile_s
+
+    @property
+    def expected_signatures(self) -> "int | None":
+        return self._stats.expected_signatures
+
+    @property
+    def retraces(self) -> int:
+        """Compilations beyond the expected signature count — the silent
+        retraces. With ``expected_signatures`` unset, a healthy entry point
+        compiles exactly once per distinct signature, so any excess means
+        identical signatures recompiled (a dropped cache, an unstable
+        static); with it pinned, shape-unstable callers show up too."""
+        return self._stats.retraces
+
+    @property
+    def retraced(self) -> bool:
+        return self._stats.retraces > 0
+
+    def stats(self) -> dict:
+        return self._stats.as_dict()
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def instrument_jit(fn, name: str,
+                   expected_signatures: int | None = None,
+                   static_argnums=(),
+                   static_argnames=()) -> InstrumentedJit:
+    """Wrap a (usually jitted) callable with compile telemetry under
+    ``name``; see :class:`InstrumentedJit`. Pass the jit's own
+    ``static_argnums``/``static_argnames`` so per-value recompiles of
+    static arguments count as distinct signatures, not retraces."""
+    return InstrumentedJit(fn, name, expected_signatures=expected_signatures,
+                           static_argnums=static_argnums,
+                           static_argnames=static_argnames)

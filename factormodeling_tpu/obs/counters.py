@@ -153,8 +153,13 @@ def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
 
 def summarize_counters(counters: StageCounters) -> dict:
     """Host-side JSON-ready summary of a collected pytree (scalars verbatim,
-    per-date/per-factor arrays reduced to mean/max; NaN-safe on empty)."""
-    c = {k: np.asarray(v) for k, v in counters._asdict().items()}
+    per-date/per-factor arrays reduced to mean/max; NaN-safe on empty).
+
+    Generated generically from ``_asdict()`` — every field of the pytree
+    appears in the summary by construction, so widening ``StageCounters``
+    (PR 3 added four fields; more will come) cannot silently drop the new
+    telemetry from reports. A test pins the field <-> summary bijection.
+    """
 
     def _mm(a):
         a = a.astype(float)
@@ -162,19 +167,12 @@ def summarize_counters(counters: StageCounters) -> dict:
             return {"mean": float("nan"), "max": float("nan")}
         return {"mean": float(a.mean()), "max": float(a.max())}
 
-    return {
-        "universe_size": _mm(c["universe_size"]),
-        "factor_nan_frac": _mm(c["factor_nan_frac"]),
-        "selection_active": _mm(c["selection_active"]),
-        "selection_churn": _mm(c["selection_churn"]),
-        "long_count": _mm(c["long_count"]),
-        "short_count": _mm(c["short_count"]),
-        "active_days": int(c["active_days"]),
-        "solver_fallback_days": int(c["solver_fallback_days"]),
-        "polish_attempted": int(c["polish_attempted"]),
-        "polish_accepted": int(c["polish_accepted"]),
-        "qp_solves": int(c["qp_solves"]),
-        "turnover_sweeps": int(c["turnover_sweeps"]),
-        "turnover_converged_days": int(c["turnover_converged_days"]),
-        "turnover_suffix_len": int(c["turnover_suffix_len"]),
-    }
+    out: dict = {}
+    for key, val in counters._asdict().items():
+        a = np.asarray(val)
+        if a.ndim == 0:
+            out[key] = (float(a) if np.issubdtype(a.dtype, np.floating)
+                        else int(a))
+        else:
+            out[key] = _mm(a)
+    return out

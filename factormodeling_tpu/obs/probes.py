@@ -1,0 +1,324 @@
+"""On-device numerics probes with provenance: where was a NaN born?
+
+The reference pipeline swallows numerical failures silently — the QP
+solver's bare ``except`` falls back to equal weights, a NaN-laden factor
+panel propagates zeros through the blend — and the only post-mortem signal
+is a wrong number at the end. A *probe* closes that gap: ``probe(name, x)``
+collects a small per-tensor summary (:class:`ProbeFrame`: finite fraction,
+NaN/Inf counts, absmax, mean/std, and a log2-magnitude histogram) INSIDE
+the jitted research step, in the same dispatch as the stage it observes,
+and the frames ride the step's output pytree exactly like
+:class:`~factormodeling_tpu.obs.counters.StageCounters`.
+
+Gating contract (same as the counters): collection is decided at TRACE
+time with **structural elision** — when no capture is active (the default),
+``probe`` is an identity function and the summary subgraph is never traced,
+so the step's HLO and outputs are bit-identical to an uninstrumented build
+(differential test in ``tests/test_obs.py``). With probes on, the frames
+are reductions over arrays the step already materializes — measured
+overhead at the 12f x 504d x 200n bench shape is within the 2% acceptance
+bound (``bench.py obs_overhead``).
+
+Provenance comes from ORDER: every frame carries a ``seq`` index assigned
+at trace time in program order, so the host-side :func:`watchdog` can
+answer "which stage's finite fraction dropped FIRST?" — a NaN injected
+into a raw factor panel is attributed to ``ops/factors_raw``, one born in
+the solver to ``solver/admm``, from the report alone. Pass a clean run's
+finite fractions as the ``baseline`` (see
+``obs.regression.numerics_baseline``) to flag *drops relative to a known
+good run*; without one, the watchdog flags the first stage with any
+non-finite cell — appropriate only for pipelines whose probed tensors are
+fully finite when healthy (raw panels and pre-history P&L rows usually are
+not; their probes declare ``expect_finite=None`` and are then skipped by
+the absolute mode).
+
+Tracer discipline: ``probe`` appends to the capture that is active at
+trace time, so it must be called at the SAME trace level as the
+:func:`capture` block — a probe inside an inner ``vmap``/``scan`` body
+would leak batch tracers into the outer collection. Stage-boundary values
+(the research step's intermediates) are all outer-level; the ADMM solver's
+per-segment residual trajectory is instead threaded out explicitly via
+``ADMMResult.residual_traj`` (see ``solvers/admm_qp.py``) and probed by
+the caller where it surfaces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["ProbeFrame", "capture", "collection_active", "enable_probes",
+           "probe", "probing", "probes_enabled", "summarize_frame",
+           "summarize_probes", "watchdog"]
+
+_ENABLED = False
+_ACTIVE: "ProbeCapture | None" = None
+
+#: log2-magnitude histogram: 8 bins of width 4 covering |x| in
+#: [2^-16, 2^16); underflow/overflow clip into the edge bins. Wide enough
+#: to separate "weights ~1e-2" from "blasted iterate ~1e+3" regimes at a
+#: glance, small enough to cost nothing.
+_HIST_BINS = 8
+_HIST_LO = -16  # log2 of the smallest resolved magnitude
+_HIST_WIDTH = 4
+
+
+def enable_probes(flag: bool = True) -> None:
+    """Globally enable/disable probe collection (trace-time gate, read when
+    a step function is BUILT — same rebuild caveat as
+    :func:`~factormodeling_tpu.obs.counters.enable_counters`)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def probes_enabled() -> bool:
+    return _ENABLED
+
+
+def collection_active() -> bool:
+    """True when probe data is being collected at this point of the trace —
+    the global :func:`enable_probes` gate OR an active :func:`capture`
+    block (``build_research_step(collect_probes=True)`` opens one without
+    touching the global). Trace-gated producers (the ADMM solver's
+    ``residual_traj``) key on THIS, so an explicitly-probed build gets its
+    solver trajectory even when the global was never flipped."""
+    return _ENABLED or _ACTIVE is not None
+
+
+@contextlib.contextmanager
+def probing(flag: bool = True):
+    """Scoped :func:`enable_probes`: probes collected by steps BUILT inside
+    the block (mirrors ``obs.collecting()`` for counters)."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(flag)
+    try:
+        yield
+    finally:
+        _ENABLED = prev
+
+
+class ProbeFrame(NamedTuple):
+    """One probed tensor's device-side summary.
+
+    seq: ``int32[]`` — program-order index assigned at trace time (the
+      watchdog's "first" ordering; a trace-time constant, free).
+    finite_frac: fraction of finite cells (1.0 for an empty tensor).
+    nan_count / inf_count: ``int32[]`` exact counts.
+    absmax / mean / std: over the FINITE cells only (0 when none).
+    log2_hist: ``int32[8]`` — counts of finite non-zero cells by
+      ``floor(log2 |x|)`` in width-4 bins from 2^-16 up (edge bins absorb
+      under/overflow).
+    expect_finite: ``f32[]`` — the probe author's declared healthy finite
+      fraction (NaN means "no expectation": raw panels and pre-history
+      rows legitimately carry NaN; the absolute-mode watchdog skips them).
+    """
+
+    seq: jnp.ndarray
+    finite_frac: jnp.ndarray
+    nan_count: jnp.ndarray
+    inf_count: jnp.ndarray
+    absmax: jnp.ndarray
+    mean: jnp.ndarray
+    std: jnp.ndarray
+    log2_hist: jnp.ndarray
+    expect_finite: jnp.ndarray
+
+
+class ProbeCapture:
+    """Ordered frame collection for one traced step (see :func:`capture`)."""
+
+    def __init__(self):
+        self._frames: dict[str, ProbeFrame] = {}
+
+    def add(self, name: str, frame: ProbeFrame) -> None:
+        base, k = name, 2
+        while name in self._frames:  # repeated stage: suffix, keep both
+            name = f"{base}#{k}"
+            k += 1
+        self._frames[name] = frame
+
+    def frames(self) -> dict[str, ProbeFrame]:
+        """The collected frames (plain dict; each frame's ``seq`` preserves
+        program order through pytree flattening's key sort)."""
+        return dict(self._frames)
+
+
+@contextlib.contextmanager
+def capture():
+    """Activate a :class:`ProbeCapture` for the duration of a trace::
+
+        with probes.capture() as cap:
+            ... stages call probe(name, x) ...
+            frames = cap.frames()   # -> ResearchOutput.probes
+
+    Used INSIDE the traced step body so every (re)trace re-collects; while
+    no capture is active, :func:`probe` is an identity pass-through and
+    traces nothing.
+    """
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = cap = ProbeCapture()
+    try:
+        yield cap
+    finally:
+        _ACTIVE = prev
+
+
+def frame_of(x: jnp.ndarray, *, seq: int = 0,
+             expect_finite: float | None = 1.0) -> ProbeFrame:
+    """The summary pytree of one tensor (traceable; the guts of
+    :func:`probe`, usable standalone in tests)."""
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.inexact):
+        x = x.astype(jnp.float32)  # bool/int probes: summarize as values
+    f32 = jnp.float32
+    exp = jnp.asarray(
+        jnp.nan if expect_finite is None else float(expect_finite), f32)
+    if x.size == 0:  # static: a zero-size tensor is trivially clean
+        zero = jnp.zeros((), jnp.int32)
+        return ProbeFrame(
+            seq=jnp.asarray(seq, jnp.int32), finite_frac=jnp.asarray(1.0, f32),
+            nan_count=zero, inf_count=zero, absmax=jnp.zeros((), f32),
+            mean=jnp.zeros((), f32), std=jnp.zeros((), f32),
+            log2_hist=jnp.zeros((_HIST_BINS,), jnp.int32), expect_finite=exp)
+    # Cost discipline: the probe rides INSIDE the hot step, so the summary
+    # is built from a minimum of full passes over x (the 2% bench gate in
+    # bench.py obs_overhead holds these choices honest on CPU, where XLA
+    # fuses none of this):
+    # - nan_count is derived (size - finite - inf), not a third scan;
+    # - mean/std come from one sum + one sum-of-squares (single-pass
+    #   moments; diagnostics-grade — catastrophic cancellation is
+    #   irrelevant at summary precision);
+    # - the histogram reads the FLOAT EXPONENT BITS via bitcast instead of
+    #   log2+floor (measured ~7x cheaper than transcendental + scatter-add
+    #   on CPU at 1.2M elements) and accumulates with 8 masked reductions.
+    n = x.size
+    finite = jnp.isfinite(x)
+    n_finite = finite.sum(dtype=jnp.int32)
+    inf_count = jnp.isinf(x).sum(dtype=jnp.int32)
+    xf = jnp.where(finite, x, 0.0).astype(f32)
+    cnt = jnp.maximum(n_finite, 1).astype(f32)
+    mean = xf.sum() / cnt
+    var = jnp.maximum((xf * xf).sum() / cnt - mean * mean, 0.0)
+    # biased-exponent extraction: (bits >> 23) & 0xff - 127 equals
+    # floor(log2 |x|) for f32 normals; denormals land in the underflow
+    # bin, overflow clips into the top bin, and non-finite/zero lanes are
+    # masked out of the counts (xf zeroed them, so no poison reaches the
+    # integer path)
+    bits = lax.bitcast_convert_type(xf, jnp.int32)
+    e = ((bits >> 23) & 0xFF) - 127
+    idx = jnp.clip((e - _HIST_LO) // _HIST_WIDTH, 0, _HIST_BINS - 1)
+    valid = finite & (x != 0)
+    hist = jnp.stack([jnp.sum(valid & (idx == k), dtype=jnp.int32)
+                      for k in range(_HIST_BINS)])
+    return ProbeFrame(
+        seq=jnp.asarray(seq, jnp.int32),
+        finite_frac=n_finite.astype(f32) / f32(n),
+        nan_count=(jnp.asarray(n, jnp.int32) - n_finite - inf_count),
+        inf_count=inf_count,
+        absmax=jnp.max(jnp.abs(xf)),
+        mean=mean,
+        std=jnp.sqrt(var),
+        log2_hist=hist,
+        expect_finite=exp,
+    )
+
+
+def probe(name: str, x, *, expect_finite: float | None = 1.0):
+    """Summarize ``x`` into the active capture and return ``x`` unchanged.
+
+    With no active :func:`capture` (the default) this is an identity
+    function — nothing is traced, the caller's HLO is untouched. With one,
+    a :class:`ProbeFrame` named ``name`` is collected in program order.
+    ``expect_finite`` declares the healthy finite fraction (``None`` for
+    tensors that legitimately carry NaN — raw factor panels, pre-history
+    P&L rows — which exempts them from the absolute-mode watchdog; the
+    baseline-relative mode compares every stage regardless). A ``None``
+    value passes through unrecorded, so gated producers
+    (``ADMMResult.residual_traj`` when collection is off) probe safely.
+    """
+    cap = _ACTIVE
+    if cap is None or x is None:
+        return x
+    cap.add(name, frame_of(x, seq=len(cap._frames),
+                           expect_finite=expect_finite))
+    return x
+
+
+# --------------------------------------------------------- host-side views
+
+
+def summarize_frame(frame: ProbeFrame) -> dict:
+    """JSON-ready dict of one frame (numpy scalars coerced)."""
+    c = {k: np.asarray(v) for k, v in frame._asdict().items()}
+    exp = float(c["expect_finite"])
+    return {
+        "seq": int(c["seq"]),
+        "finite_frac": float(c["finite_frac"]),
+        "nan_count": int(c["nan_count"]),
+        "inf_count": int(c["inf_count"]),
+        "absmax": float(c["absmax"]),
+        "mean": float(c["mean"]),
+        "std": float(c["std"]),
+        "log2_hist": [int(v) for v in c["log2_hist"].ravel()],
+        "expect_finite": None if np.isnan(exp) else exp,
+    }
+
+
+def summarize_probes(frames: dict) -> dict:
+    """Per-stage JSON-ready summaries, ordered by trace-time ``seq``."""
+    items = sorted(((name, summarize_frame(f)) for name, f in frames.items()),
+                   key=lambda kv: kv[1]["seq"])
+    return dict(items)
+
+
+def watchdog(frames: dict, baseline: dict | None = None,
+             tol: float = 1e-6) -> dict:
+    """Pinpoint the FIRST stage (by trace order) whose finite fraction
+    dropped.
+
+    Args:
+      frames: ``{name: ProbeFrame}`` (or already-summarized dicts from
+        :func:`summarize_frame` / a report's numerics rows).
+      baseline: optional ``{name: finite_frac}`` from a known-good run
+        (``obs.regression.numerics_baseline`` extracts one from a report).
+        Without it, a stage is bad when its finite fraction is below its
+        own declared ``expect_finite`` (stages probed with
+        ``expect_finite=None`` are skipped — their NaN share is legitimate
+        and only a baseline can judge it). Stages ABSENT from a given
+        baseline — a probe added or renamed after the baseline was taken,
+        the likeliest NaN source — fall back to their absolute
+        ``expect_finite`` check rather than passing silently.
+
+    Returns a JSON-ready dict: ``first_bad_stage`` (None when clean),
+    ``dropped`` (every offending stage in order), and the per-stage
+    ``finite_frac`` map the verdict was computed from.
+    """
+    summaries = {}
+    for name, f in frames.items():
+        summaries[name] = f if isinstance(f, dict) else summarize_frame(f)
+    ordered = sorted(summaries.items(), key=lambda kv: kv[1].get("seq", 0))
+    dropped = []
+    for name, s in ordered:
+        frac = float(s["finite_frac"])
+        if baseline is not None and name in baseline:
+            if frac < float(baseline[name]) - tol:
+                dropped.append(name)
+        else:
+            # no baseline, or a stage the baseline has never seen: judge
+            # by the probe's own declared expectation
+            expect = s.get("expect_finite", 1.0)
+            if expect is not None and frac < float(expect) - tol:
+                dropped.append(name)
+    return {
+        "mode": "baseline" if baseline is not None else "absolute",
+        "first_bad_stage": dropped[0] if dropped else None,
+        "dropped": dropped,
+        "finite_frac": {name: float(s["finite_frac"])
+                        for name, s in ordered},
+    }

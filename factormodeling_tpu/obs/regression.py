@@ -1,0 +1,279 @@
+"""RunReport regression gating: diff two report JSONLs, machine-checkably.
+
+PR 2 made every run emit a structured report (spans, counters, numerics
+frames, compile rows); this module adds the *judgment*: given a known-good
+baseline report and a fresh one, decide — with an exit code, not a human
+squint — whether the fresh run regressed, and if a NaN appeared, WHICH
+stage it was born in.
+
+Checks (each can be tuned/disabled by the caller / ``tools/report_diff.py``
+flags):
+
+- **spans** — every baseline span name must still exist; per-name total
+  wall seconds may not exceed ``wall_ratio`` x baseline (only spans whose
+  baseline total is at least ``wall_min_s``, so microsecond stages cannot
+  flake the gate).
+- **counters** — every baseline counter key must still exist; keys with a
+  known "bad direction" (``GATE_UP``: solver fallbacks, NaN share,
+  retraces, ...) gate on increases beyond ``counter_tol``; everything else
+  is reported as informational drift.
+- **numerics** — every baseline probe stage must still exist; a stage
+  whose finite fraction dropped more than ``finite_tol`` below baseline is
+  a regression, and the FIRST such stage in trace order is the watchdog
+  attribution (``first_bad_stage``) — the report-level answer to "where
+  was this NaN born?". NaN/Inf count increases on a stage with an intact
+  finite fraction are informational (a bigger tensor can carry more
+  legitimate NaN).
+
+Deliberately **pure stdlib** with no package-relative imports:
+``tools/report_diff.py`` loads this file standalone (importlib by path) so
+the gate runs on any box that has two JSONLs — CI, a laptop, a box with no
+jax — exactly like ``tools/trace_report.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+__all__ = ["DiffResult", "Finding", "GATE_UP", "counter_scalars",
+           "diff_reports", "load_jsonl", "numerics_baseline", "span_totals"]
+
+#: counter keys whose INCREASE is a regression (everything else drifts
+#: informationally). Nested mean/max counters gate on their "mean" leaf.
+GATE_UP = ("solver_fallback_days", "factor_nan_frac", "retraces",
+           "turnover_suffix_len")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diff observation. ``regression`` findings drive the exit code;
+    the rest are context."""
+
+    kind: str       # "span" | "counter" | "numerics" | "schema" | "watchdog"
+    name: str
+    detail: str
+    regression: bool = False
+
+    def render(self) -> str:
+        tag = "REGRESSION" if self.regression else "note"
+        return f"{tag} [{self.kind}] {self.name}: {self.detail}"
+
+
+@dataclasses.dataclass
+class DiffResult:
+    findings: list
+    first_bad_stage: "str | None" = None
+
+    @property
+    def regressions(self) -> list:
+        return [f for f in self.findings if f.regression]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        if self.first_bad_stage is not None:
+            lines.append(f"watchdog: first bad stage = {self.first_bad_stage}")
+        lines.append(f"report_diff: {len(self.regressions)} regression(s), "
+                     f"{len(self.findings) - len(self.regressions)} note(s)")
+        return "\n".join(lines)
+
+
+def load_jsonl(path) -> list:
+    """Rows of one report JSONL; unparseable lines (a run killed mid-write
+    truncates the last one) are skipped with a warning naming file and line
+    — same contract as ``tools/trace_report.py``."""
+    rows = []
+    path = Path(path)
+    with path.open() as fh:
+        for lineno, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                print(f"warning: {path}:{lineno}: skipping unparseable "
+                      f"JSONL line ({e})", file=sys.stderr)
+    return rows
+
+
+# ----------------------------------------------------------------- views
+
+
+def span_totals(rows) -> dict:
+    """name -> total wall seconds over every span row."""
+    out: dict = defaultdict(float)
+    for r in rows:
+        if r.get("kind") == "span":
+            out[r["name"]] += float(r.get("wall_s", 0.0))
+    return dict(out)
+
+
+def counter_scalars(rows) -> dict:
+    """(row_name, counter_key) -> gateable scalar. Nested ``{mean, max}``
+    counters contribute their ``mean``; non-numeric values are skipped."""
+    out: dict = {}
+    for r in rows:
+        if r.get("kind") != "counters":
+            continue
+        for key, val in (r.get("counters") or {}).items():
+            if isinstance(val, dict):
+                val = val.get("mean")
+            if isinstance(val, (int, float)) and val == val:  # finite-ish
+                out[(r["name"], key)] = float(val)
+    return out
+
+
+def numerics_frames(rows) -> dict:
+    """(step_name, stage) -> numerics row (kind="numerics"; last occurrence
+    wins). Keyed like :func:`counter_scalars` — by the probed STEP as well
+    as the stage — so two instrumented steps that both probe a
+    ``solver/admm`` stage never overwrite each other in a diff."""
+    return {(r.get("name", ""), r["stage"]): r for r in rows
+            if r.get("kind") == "numerics" and "stage" in r}
+
+
+def numerics_baseline(rows, name: str | None = None) -> dict:
+    """stage -> finite_frac from a report's numerics rows — the ``baseline``
+    argument of ``obs.probes.watchdog`` and of ``RunReport.add_probes``.
+    ``name`` selects one probed step's rows when the report carries
+    several (stage keys collide across steps; without a filter the last
+    row per stage wins)."""
+    return {stage: float(r.get("finite_frac", 1.0))
+            for (step, stage), r in numerics_frames(rows).items()
+            if name is None or step == name}
+
+
+def compile_rows(rows) -> dict:
+    """name -> last compile row (cumulative fields, so last is the total)."""
+    return {r["name"]: r for r in rows if r.get("kind") == "compile"}
+
+
+# ------------------------------------------------------------------ diff
+
+
+def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
+                 wall_min_s: float = 0.05, check_wall: bool = True,
+                 counter_tol: float = 1e-9,
+                 finite_tol: float = 1e-6) -> DiffResult:
+    """Compare a fresh report against a known-good baseline (see module
+    docs for the checks). Returns a :class:`DiffResult`; ``not result.ok``
+    means gate-failing regressions were found."""
+    findings: list = []
+
+    # ---- spans
+    base_spans, new_spans = span_totals(base_rows), span_totals(new_rows)
+    for name, base_s in sorted(base_spans.items()):
+        if name not in new_spans:
+            findings.append(Finding("schema", name,
+                                    "span present in baseline, missing in "
+                                    "new report", regression=True))
+            continue
+        if not check_wall or base_s < wall_min_s:
+            continue
+        ratio = new_spans[name] / base_s if base_s > 0 else float("inf")
+        if ratio > wall_ratio:
+            findings.append(Finding(
+                "span", name,
+                f"wall {base_s:.4f}s -> {new_spans[name]:.4f}s "
+                f"({ratio:.2f}x > {wall_ratio:g}x tolerance)",
+                regression=True))
+
+    # ---- counters
+    base_c, new_c = counter_scalars(base_rows), counter_scalars(new_rows)
+    for (name, key), base_v in sorted(base_c.items()):
+        if (name, key) not in new_c:
+            findings.append(Finding("schema", f"{name}/{key}",
+                                    "counter present in baseline, missing "
+                                    "in new report", regression=True))
+            continue
+        delta = new_c[(name, key)] - base_v
+        if abs(delta) <= counter_tol:
+            continue
+        worse = any(key == g or key.endswith(g) for g in GATE_UP) and delta > 0
+        findings.append(Finding(
+            "counter", f"{name}/{key}",
+            f"{base_v:g} -> {new_c[(name, key)]:g} (delta {delta:+g})",
+            regression=worse))
+
+    # ---- numerics frames (+ watchdog attribution)
+    base_n, new_n = numerics_frames(base_rows), numerics_frames(new_rows)
+    first_bad = None
+    first_bad_label = None
+    # ONE pass over the NEW report's rows in insertion order: rows are
+    # appended chronologically (per-step in seq order by add_probes), so
+    # insertion order IS the trace order of the run where the NaN actually
+    # happened — a (step, seq) sort would let an alphabetically-early
+    # downstream step steal the first-bad attribution, and a separate
+    # new-only second loop would let a renamed upstream probe lose it to
+    # a downstream baseline stage.
+    for (step, stage), new_row in new_n.items():
+        label = f"{step}/{stage}" if step else stage
+        new_f = float(new_row.get("finite_frac", 1.0))
+        base_row = base_n.get((step, stage))
+        if base_row is not None:
+            base_f = float(base_row.get("finite_frac", 1.0))
+            if new_f < base_f - finite_tol:
+                findings.append(Finding(
+                    "numerics", label,
+                    f"finite fraction dropped {base_f:.6g} -> {new_f:.6g}",
+                    regression=True))
+                if first_bad is None:
+                    first_bad, first_bad_label = stage, label
+            else:
+                d_nan = (int(new_row.get("nan_count", 0))
+                         - int(base_row.get("nan_count", 0)))
+                if d_nan > 0:
+                    findings.append(Finding(
+                        "numerics", label,
+                        f"nan_count +{d_nan} with finite fraction intact"))
+            continue
+        # a stage the baseline has never seen — a probe added/renamed
+        # since it was taken, the likeliest NaN source — is judged by its
+        # own declared expect_finite instead of passing silently
+        expect = new_row.get("expect_finite")
+        if expect is not None and new_f < float(expect) - finite_tol:
+            findings.append(Finding(
+                "numerics", label,
+                f"stage absent from baseline and finite fraction "
+                f"{new_f:.6g} below its declared expectation {expect:g}",
+                regression=True))
+            if first_bad is None:
+                first_bad, first_bad_label = stage, label
+        else:
+            findings.append(Finding(
+                "numerics", label, "stage absent from baseline (new or "
+                "renamed probe) — re-baseline to gate it"))
+    for (step, stage) in base_n:
+        if (step, stage) not in new_n:
+            label = f"{step}/{stage}" if step else stage
+            findings.append(Finding("schema", label,
+                                    "numerics frame present in baseline, "
+                                    "missing in new report",
+                                    regression=True))
+    if first_bad is not None:
+        findings.append(Finding(
+            "watchdog", first_bad_label,
+            "first stage (trace order) whose finite fraction dropped vs "
+            "baseline — the NaN was born here or in the un-probed gap "
+            "right before", regression=True))
+
+    # ---- compile rows: retraces are gated, totals drift informationally
+    base_k, new_k = compile_rows(base_rows), compile_rows(new_rows)
+    for name, new_row in sorted(new_k.items()):
+        base_retr = int(base_k.get(name, {}).get("retraces", 0) or 0)
+        new_retr = int(new_row.get("retraces", 0) or 0)
+        if new_retr > base_retr:
+            findings.append(Finding(
+                "counter", f"{name}/retraces",
+                f"{base_retr} -> {new_retr} silent retraces",
+                regression=True))
+
+    return DiffResult(findings=findings, first_bad_stage=first_bad)

@@ -96,7 +96,11 @@ class RunReport:
         line up with the device trace in the profiler UI. A body that
         raises still records its (truncated) row, marked ``error: true``
         so aggregations can tell a crashed stage from a fast one; the
-        exception propagates.
+        exception propagates. Error rows report ``fenced: false`` even
+        when outputs were registered — the fence is SKIPPED on that path,
+        so the truncated window may have timed dispatch only and
+        ``tools/trace_report.py``'s soundness column must not overclaim a
+        crashed stage as soundly timed.
         """
         import sys
 
@@ -108,13 +112,13 @@ class RunReport:
             try:
                 yield handle
             finally:
-                if handle._outputs and sys.exc_info()[0] is None:
+                raised = sys.exc_info()[0] is not None
+                if handle._outputs and not raised:
                     jax.block_until_ready(handle._outputs)
                 wall = time.perf_counter() - t0
-                err = ({"error": True} if sys.exc_info()[0] is not None
-                       else {})
+                err = {"error": True} if raised else {}
                 self.record(name, kind="span", wall_s=round(wall, 6),
-                            fenced=bool(handle._outputs),
+                            fenced=bool(handle._outputs) and not raised,
                             **{**fields, **handle.fields, **err})
 
     def add_counters(self, name: str, counters) -> None:
@@ -130,6 +134,30 @@ class RunReport:
 
         self.record(name, kind="counters",
                     counters=summarize_counters(counters))
+
+    def add_probes(self, name: str, probes, baseline: dict | None = None,
+                   tol: float = 1e-6) -> dict | None:
+        """Record a step's numerics probes (``ResearchOutput.probes`` — a
+        ``{stage: ProbeFrame}`` dict) as one ``kind="numerics"`` row per
+        stage plus a ``kind="watchdog"`` attribution row. None is ignored,
+        so callers can pass ``output.probes`` unconditionally.
+
+        ``baseline`` maps stage -> known-good finite fraction (extract one
+        from a clean report with ``obs.regression.numerics_baseline``);
+        the watchdog then flags the first stage that DROPPED versus it —
+        NaN provenance relative to a clean run. Without it, the absolute
+        mode flags the first stage below its own declared
+        ``expect_finite``. Returns the watchdog row (or None when no
+        probes were given)."""
+        if not probes:
+            return None
+        from factormodeling_tpu.obs import probes as _probes
+
+        summaries = _probes.summarize_probes(probes)
+        for stage, summary in summaries.items():
+            self.record(name, kind="numerics", stage=stage, **summary)
+        verdict = _probes.watchdog(summaries, baseline=baseline, tol=tol)
+        return self.record(name, kind="watchdog", **verdict)
 
     def add_cost_analysis(self, name: str, fn, *args, **kwargs) -> dict:
         """FLOP/byte estimates from ``jit(fn).lower(*args).cost_analysis()``.

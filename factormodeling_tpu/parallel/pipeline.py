@@ -16,6 +16,7 @@ windows and 1-day shifts across date-shard boundaries.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, NamedTuple
 
 import jax
@@ -28,7 +29,9 @@ from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.composite import composite_weighted
 from factormodeling_tpu.metrics.factor_metrics import nan_mean_std
 from factormodeling_tpu.obs import counters as obs_counters
+from factormodeling_tpu.obs import probes as obs_probes
 from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.compile_log import entry_point_tag, instrument_jit
 from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.parallel.mesh import panel_sharding, stack_sharding
 from factormodeling_tpu.selection import rolling_selection
@@ -65,6 +68,10 @@ class ResearchOutput(NamedTuple):
     # leaf is structurally absent, so the disabled step's HLO and outputs
     # are bit-identical to a build without the obs layer.
     counters: obs_counters.StageCounters | None = None
+    # {stage: ProbeFrame} numerics probes when built with collect_probes
+    # (obs.probing()); None is structurally absent under the same elision
+    # contract. Feed to RunReport.add_probes / obs.probes.watchdog.
+    probes: dict | None = None
 
 
 def _nan_mean_std(x: jnp.ndarray):
@@ -93,7 +100,8 @@ def build_research_step(*, names, window: int,
                         select_kwargs: dict[str, Any] | None = None,
                         blend_method: str = "zscore",
                         sim_kwargs: dict[str, Any] | None = None,
-                        collect_counters: bool | None = None):
+                        collect_counters: bool | None = None,
+                        collect_probes: bool | None = None):
     """Close the static config over a jittable
     ``step(factors, returns, factor_ret, cap_flag, investability, universe)``.
 
@@ -109,40 +117,81 @@ def build_research_step(*, names, window: int,
     the step's output (None -> the ``obs.collecting()`` global, read here
     at build time). When off, the counter subgraph is never traced —
     structural elision, not a masked branch — so outputs are bit-identical
-    to an uninstrumented build. Every stage traces under an
-    ``obs.stage(...)`` named scope either way (metadata only, free).
+    to an uninstrumented build. ``collect_probes`` gates the numerics
+    probes (:mod:`factormodeling_tpu.obs.probes`) under the identical
+    contract (None -> the ``obs.probing()`` global): on, every stage
+    boundary contributes a :class:`~factormodeling_tpu.obs.probes.ProbeFrame`
+    to ``output.probes`` — raw factor stack, selection, composite signal,
+    per-day solver residuals, shifted weights, daily P&L — so a NaN is
+    attributable to the stage that birthed it; off, the subgraph is never
+    traced. Every stage traces under an ``obs.stage(...)`` named scope
+    either way (metadata only, free).
     """
     names = tuple(names)
     select_kwargs = dict(select_kwargs or {})
     sim_kwargs = dict(sim_kwargs or {})
     if collect_counters is None:
         collect_counters = obs_counters.counters_enabled()
+    if collect_probes is None:
+        collect_probes = obs_probes.probes_enabled()
 
     def step(factors, returns, factor_ret, cap_flag, investability,
              universe) -> ResearchOutput:
-        with obs_stage("selection/rolling"):
-            selection = rolling_selection(
-                factors, returns, factor_ret, window,
-                method=select_method, method_kwargs=select_kwargs,
-                universe=universe)
-        with obs_stage("composite/blend"):
-            signal = composite_weighted(factors, names, selection,
-                                        method=blend_method,
-                                        universe=universe)
-        settings = SimulationSettings(
-            returns=returns, cap_flag=cap_flag,
-            investability_flag=investability, universe=universe,
-            **sim_kwargs)
-        sim = run_simulation(signal, settings)
-        with obs_stage("pipeline/summary"):
-            summary = result_summary(sim.result)
-        counters = None
-        if collect_counters:
-            with obs_stage("obs/stage_counters"):
-                counters = obs_counters.stage_counters(factors, universe,
-                                                       selection, sim)
+        # the capture is (re)entered on every trace of the step, so probes
+        # survive retraces and fresh jits; with probes off the nullcontext
+        # leaves obs_probes.probe as an identity and nothing is traced
+        cap_ctx = (obs_probes.capture() if collect_probes
+                   else contextlib.nullcontext())
+        with cap_ctx as cap:
+            if collect_probes:
+                # raw panels legitimately carry NaN (expect_finite=None):
+                # only a baseline-relative watchdog judges their NaN share
+                obs_probes.probe("ops/factors_raw", factors,
+                                 expect_finite=None)
+            with obs_stage("selection/rolling"):
+                selection = rolling_selection(
+                    factors, returns, factor_ret, window,
+                    method=select_method, method_kwargs=select_kwargs,
+                    universe=universe)
+            if collect_probes:
+                obs_probes.probe("selection/rolling", selection)
+            with obs_stage("composite/blend"):
+                signal = composite_weighted(factors, names, selection,
+                                            method=blend_method,
+                                            universe=universe)
+            if collect_probes:
+                # the blend leaves out-of-universe cells NaN by design, so
+                # its healthy finite fraction is the universe coverage,
+                # not 1.0
+                obs_probes.probe("composite/blend", signal,
+                                 expect_finite=None)
+            settings = SimulationSettings(
+                returns=returns, cap_flag=cap_flag,
+                investability_flag=investability, universe=universe,
+                **sim_kwargs)
+            sim = run_simulation(signal, settings)
+            if collect_probes:
+                # per-day final ADMM residuals: the solver's convergence
+                # trajectory across the run (NaN on no-solver days); the
+                # per-segment in-solve trajectory is ADMMResult.residual_traj
+                obs_probes.probe("solver/admm",
+                                 sim.diagnostics.primal_residual,
+                                 expect_finite=None)
+                obs_probes.probe("backtest/weights", sim.weights,
+                                 expect_finite=None)
+                obs_probes.probe("backtest/pnl", sim.result.log_return,
+                                 expect_finite=None)
+            with obs_stage("pipeline/summary"):
+                summary = result_summary(sim.result)
+            counters = None
+            if collect_counters:
+                with obs_stage("obs/stage_counters"):
+                    counters = obs_counters.stage_counters(factors, universe,
+                                                           selection, sim)
+            probes = cap.frames() if collect_probes else None
         return ResearchOutput(selection=selection, signal=signal, sim=sim,
-                              summary=summary, counters=counters)
+                              summary=summary, counters=counters,
+                              probes=probes)
 
     return step
 
@@ -154,13 +203,18 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
                                sim_kwargs: dict[str, Any] | None = None,
                                factor_axis: str = "factor",
                                date_axis: str = "date",
-                               collect_counters: bool | None = None):
+                               collect_counters: bool | None = None,
+                               collect_probes: bool | None = None):
     """Jit the research step over a 2-D mesh with the canonical shardings.
 
     Returns ``(jitted_step, shard_inputs)`` where ``shard_inputs`` device_puts
     a raw input tuple onto the mesh with the declared shardings.
-    ``collect_counters`` is threaded to :func:`build_research_step`; the
-    counter reductions shard like the stage they observe.
+    ``collect_counters`` / ``collect_probes`` are threaded to
+    :func:`build_research_step`; the counter/probe reductions shard like
+    the stage they observe. The returned step carries compile telemetry
+    (:func:`factormodeling_tpu.obs.compile_log.instrument_jit`): each
+    compile lands as a ``kind="compile"`` row on the active RunReport and
+    the retrace detector watches the entry point.
     """
     f_size = mesh.shape[factor_axis]
     if len(tuple(names)) % f_size:
@@ -169,12 +223,20 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
             f"'{factor_axis}' axis ({f_size}); pad the factor stack (unique "
             f"prefixes, all-NaN exposures) or pick a mesh whose factor axis "
             f"divides F")
+    # resolve the obs gates here (same read build_research_step would do)
+    # so the telemetry tag below reflects the BUILT structure, not the
+    # unresolved None
+    if collect_counters is None:
+        collect_counters = obs_counters.counters_enabled()
+    if collect_probes is None:
+        collect_probes = obs_probes.probes_enabled()
     step = build_research_step(names=names, window=window,
                                select_method=select_method,
                                select_kwargs=select_kwargs,
                                blend_method=blend_method,
                                sim_kwargs=sim_kwargs,
-                               collect_counters=collect_counters)
+                               collect_counters=collect_counters,
+                               collect_probes=collect_probes)
     record_stage("parallel/pipeline", kind="stage",
                  mesh_shape=dict(mesh.shape), factors=len(tuple(names)),
                  window=window, select_method=select_method,
@@ -184,7 +246,20 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
     frs = NamedSharding(mesh, PartitionSpec(date_axis, factor_axis))  # [D, F]
     in_shardings = (fs, ps, frs, ps, ps, ps)
 
-    jitted = jax.jit(step, in_shardings=in_shardings)
+    # one mesh research step serves one shape signature in steady state:
+    # a second compile of the same signature is the classic silent-retrace
+    # perf bug, which the instrumented wrapper makes visible. The name
+    # carries a stable tag of the static config + mesh layout (neither is
+    # visible in the call-signature set), so two legitimately different
+    # builds don't pool their compile counts into a phantom retrace.
+    jitted = instrument_jit(
+        jax.jit(step, in_shardings=in_shardings),
+        "parallel/research_step/" + entry_point_tag(
+            names, window, select_method,
+            tuple(sorted((select_kwargs or {}).items())),
+            blend_method, tuple(sorted((sim_kwargs or {}).items())),
+            tuple(mesh.shape.items()), factor_axis, date_axis,
+            collect_counters, collect_probes))
 
     d_size = mesh.shape[date_axis]
 

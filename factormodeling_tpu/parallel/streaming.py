@@ -49,6 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from factormodeling_tpu import ops
 from factormodeling_tpu.metrics import daily_factor_stats
 from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.compile_log import entry_point_tag, instrument_jit
 from factormodeling_tpu.obs.trace import stage as obs_stage
 
 __all__ = ["chunk_sharding", "chunk_slices", "clear_streaming_cache",
@@ -99,11 +100,21 @@ def streaming_cache_stats() -> dict:
 
 def _cached_kernel(source, config, build):
     """jit for (source, config), LRU-bounded; ``source`` (None for the host
-    path) participates in the key by identity."""
+    path) participates in the key by identity. Kernels carry compile
+    telemetry (``obs.compile_log``): per-kernel compile seconds land as
+    RunReport rows and the retrace detector catches a cache-defeating
+    unstable source before it becomes a minutes-long slowdown."""
     key = (source, config)
     fn = _kernel_cache.pop(key, None)
     if fn is None:
-        fn = build()
+        # telemetry name: kind + a stable tag of the FULL config, so two
+        # legitimately different kernels of one kind (e.g. distinct
+        # shift_periods) don't pool their compile stats and read as a
+        # retrace; the tag is callable-qualname-based, so the storm this
+        # cache guards against (fresh lambda sources, one config) still
+        # accumulates under a single name and flags
+        fn = instrument_jit(build(), f"streaming/{config[0]}/kernel/"
+                                     f"{entry_point_tag(config)}")
         _cache_stats["misses"] += 1
     else:
         _cache_stats["hits"] += 1

@@ -67,6 +67,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from factormodeling_tpu.obs import probes as _obs_probes
+
 __all__ = ["ADMMWarmState", "BoxQPProblem", "admm_solve_dense",
            "admm_solve_lowrank"]
 
@@ -94,6 +96,14 @@ class ADMMResult(NamedTuple):
     polished: jnp.ndarray   # bool: active-set polish ran AND was accepted
     polish_pre_residual: jnp.ndarray   # box/eq residual before polish (NaN
     polish_post_residual: jnp.ndarray  # / after; NaN when polish disabled)
+    # [n_segments, 3] per-segment (primal residual, dual residual, rho) —
+    # the solve's convergence trajectory, collected only when numerics
+    # probes are enabled at trace time (obs.probing()); None otherwise, a
+    # structurally absent pytree leaf, so the production solver graph is
+    # untouched. Probe it where it surfaces in the OUTER trace (e.g.
+    # obs.probe("solver/admm/residual_traj", res.residual_traj)); inside
+    # the engine's scan/map consumers it is unused and DCE'd away.
+    residual_traj: jnp.ndarray | None = None
 
     @property
     def warm_state(self) -> "ADMMWarmState":
@@ -464,7 +474,10 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
         done = (r_prim + r_dual) <= jnp.finfo(dtype).eps
         rho_new = jnp.where(done, rho, rho_new)
         u = u * (rho / rho_new)
-        return x, z, u, rho_new
+        # the per-segment residual pair is the solve's convergence
+        # trajectory — returned alongside the carry so the probes-enabled
+        # build can record it (unused otherwise; XLA DCEs it away)
+        return (x, z, u, rho_new), jnp.stack((r_prim, r_dual, rho_new))
 
     # Problem-aware initial penalty: the z-step soft-threshold moves by
     # l1/rho per iteration, and the useful threshold scale is the typical
@@ -503,6 +516,13 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
     # 256x200 — enough to break the leg-sum invariant the engine promises).
     # Force full-f32 dots for everything traced in the loop; the matvecs
     # are tiny and latency-bound, so the extra MXU passes are free.
+    # per-segment residual trajectory, collected when numerics probing is
+    # active at trace time — the obs.probing() global OR an enclosing
+    # probes.capture() (a collect_probes=True research step) — a None leaf
+    # otherwise, so the production graph and ADMMResult structure are
+    # untouched
+    collect_traj = _obs_probes.collection_active()
+    traj = None
     with jax.default_matmul_precision("highest"):
         with jax.named_scope("solver/admm"):
             if unroll > 1:
@@ -513,19 +533,35 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
                 # like the rolled path.
                 schedule = ([min(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
                              for k in range(-(-iters // _ADAPT_EVERY))] or [0])
+                seg_stats = []
                 for seg_len in schedule:
-                    carry = segment(carry, seg_len,
-                                    max(min(seg_len, unroll), 1))
+                    carry, st = segment(carry, seg_len,
+                                        max(min(seg_len, unroll), 1))
+                    seg_stats.append(st)
+                if collect_traj:
+                    traj = jnp.stack(seg_stats)
             else:
                 # rolled path: one traced segment body inside a fori_loop
                 # (cheapest to compile; the last segment runs the remainder)
-                def seg_k(k, c):
-                    seg_len = jnp.minimum(_ADAPT_EVERY,
-                                          iters - k * _ADAPT_EVERY)
-                    return segment(c, seg_len, 1)
-
                 n_seg = max(-(-iters // _ADAPT_EVERY), 1)  # ceil == iters
-                carry = lax.fori_loop(0, n_seg, seg_k, carry)
+
+                def seg_len_at(k):
+                    return jnp.minimum(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
+
+                if collect_traj:
+                    def seg_k(k, state):
+                        c, buf = state
+                        c, st = segment(c, seg_len_at(k), 1)
+                        return c, buf.at[k].set(st)
+
+                    carry, traj = lax.fori_loop(
+                        0, n_seg, seg_k,
+                        (carry, jnp.zeros((n_seg, 3), dtype)))
+                else:
+                    def seg_k(k, c):
+                        return segment(c, seg_len_at(k), 1)[0]
+
+                    carry = lax.fori_loop(0, n_seg, seg_k, carry)
             x, z, u, rho = carry
             x = x_step(factor(rho), z, u, rho)  # final equality-exact x-step
             prim = jnp.max(jnp.abs(x - z))
@@ -562,7 +598,7 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
                 prim = jnp.where(accepted, post_r, prim)
     return ADMMResult(x=x, z=z, primal_residual=prim, u=u, rho=rho,
                       polished=accepted, polish_pre_residual=pre_r,
-                      polish_post_residual=post_r)
+                      polish_post_residual=post_r, residual_traj=traj)
 
 
 def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,

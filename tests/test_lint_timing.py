@@ -29,6 +29,20 @@ def test_default_targets_cover_the_sweep_loop_driver():
     assert {"mvo.py", "engine.py", "admm_qp.py", "bench.py"} <= names
 
 
+def test_default_targets_cover_examples_and_obs_layer():
+    """Round 9 extends the surface to examples/ (the copy-paste timing
+    idiom users start from) and factormodeling_tpu/obs/ (where wall-clock
+    windows are MADE: obs.span's fence-inside-the-window discipline and
+    the compile-log's monitoring-fed clocks must stay lint-clean in their
+    own source)."""
+    targets = lint_timing.default_targets(REPO)
+    names = {p.name for p in targets}
+    assert {"pipeline.py", "run_reference_notebook.py", "report.py",
+            "probes.py", "compile_log.py", "report_diff.py"} <= names
+    dirs = {p.parent.name for p in targets}
+    assert {"examples", "obs", "tools"} <= dirs
+
+
 def _lint_snippet(tmp_path, code):
     f = tmp_path / "snippet.py"
     f.write_text(textwrap.dedent(code))

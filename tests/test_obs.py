@@ -95,6 +95,195 @@ def test_counter_elision_is_bit_identical_and_counters_are_right(rng):
     assert summary["active_days"] == int(c.active_days)
 
 
+def test_summarize_counters_covers_every_field(rng):
+    """The summary is generated from _asdict(), so EVERY StageCounters
+    field must appear — widening the pytree (PR 3 did once) can never
+    silently drop telemetry from reports again."""
+    args = make_inputs(rng)
+    out = jax.jit(build_research_step(names=NAMES, window=10,
+                                      collect_counters=True))(*args)
+    summary = obs.summarize_counters(out.counters)
+    assert set(summary) == set(obs.StageCounters._fields)
+    json.dumps(summary)  # JSON-ready: no numpy scalars survive
+    # scalars verbatim, arrays as mean/max — spot-check both shapes
+    assert isinstance(summary["active_days"], int)
+    assert set(summary["universe_size"]) == {"mean", "max"}
+
+
+def test_probe_elision_is_bit_identical(rng):
+    """The probes-off differential: a build with the probes module present
+    but disabled must be INDISTINGUISHABLE from a build that never had it
+    — same compiled HLO text, same output bits (the counters' elision
+    contract, extended)."""
+    args = make_inputs(rng)
+    off_a = build_research_step(names=NAMES, window=10,
+                                collect_probes=False)
+    off_b = build_research_step(names=NAMES, window=10)  # default: off
+    on = build_research_step(names=NAMES, window=10, collect_probes=True)
+
+    hlo_a = jax.jit(off_a).lower(*args).compile().as_text()
+    hlo_b = jax.jit(off_b).lower(*args).compile().as_text()
+    assert hlo_a == hlo_b  # probes-off == never-probed, to the HLO byte
+
+    out_off = jax.jit(off_a)(*args)
+    out_on = jax.jit(on)(*args)
+    assert out_off.probes is None and out_on.probes is not None
+    # probes-on numerics equivalence: instrumentation never moves numbers
+    assert (_leaves_bytes(out_off._replace(counters=None, probes=None))
+            == _leaves_bytes(out_on._replace(counters=None, probes=None)))
+
+    # the probing() global drives the build-time default
+    with obs.probing():
+        assert obs.probes_enabled()
+    assert not obs.probes_enabled()
+
+
+def test_probe_frames_match_numpy_and_watchdog_attributes(rng):
+    """Frame fields against a numpy recomputation, plus both watchdog
+    modes (absolute expect_finite / baseline-relative first-drop)."""
+    from factormodeling_tpu.obs import probes as P
+
+    x = rng.normal(size=(30, 16)).astype(np.float32)
+    x[rng.uniform(size=x.shape) < 0.1] = np.nan
+    x[0, 0] = np.inf
+    frame = jax.jit(lambda a: P.frame_of(a, seq=3,
+                                         expect_finite=0.5))(jnp.asarray(x))
+    s = P.summarize_frame(frame)
+    finite = np.isfinite(x)
+    assert s["seq"] == 3
+    assert s["nan_count"] == int(np.isnan(x).sum())
+    assert s["inf_count"] == 1
+    np.testing.assert_allclose(s["finite_frac"], finite.mean(), rtol=1e-6)
+    np.testing.assert_allclose(s["absmax"], np.abs(x[finite]).max(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(s["mean"], x[finite].mean(), atol=1e-5)
+    np.testing.assert_allclose(s["std"], x[finite].std(), atol=1e-4)
+    # histogram partitions the finite non-zero cells; N(0,1) magnitudes
+    # live in the 2^-16..2^4 bins
+    assert sum(s["log2_hist"]) == int((finite & (x != 0)).sum())
+    assert s["expect_finite"] == 0.5
+
+    # absolute mode: first frame below its own declared expectation
+    frames = {
+        "a": P.summarize_frame(P.frame_of(jnp.ones(4), seq=0)),
+        "b": P.summarize_frame(P.frame_of(
+            jnp.asarray([1.0, jnp.nan]), seq=1, expect_finite=None)),
+        "c": P.summarize_frame(P.frame_of(
+            jnp.asarray([1.0, jnp.nan, 2.0, 3.0]), seq=2)),
+    }
+    verdict = P.watchdog(frames)
+    assert verdict["first_bad_stage"] == "c"  # b is exempt (expect None)
+    assert verdict["mode"] == "absolute"
+
+    # baseline-relative: the exempt stage IS judged against a baseline
+    verdict = P.watchdog(frames, baseline={"a": 1.0, "b": 1.0, "c": 0.75})
+    assert verdict["first_bad_stage"] == "b"
+    assert verdict["dropped"] == ["b"]
+
+    # zero-size tensors are trivially clean
+    empty = P.summarize_frame(P.frame_of(jnp.zeros((0, 4))))
+    assert empty["finite_frac"] == 1.0 and empty["nan_count"] == 0
+
+
+def test_solver_contributes_residual_trajectory(rng):
+    """With probes on at trace time, ADMMResult carries the per-segment
+    (r_prim, r_dual, rho) trajectory; off, the leaf is structurally
+    absent and the solution bits are untouched."""
+    from factormodeling_tpu.solvers import BoxQPProblem, admm_solve_dense
+
+    n = 10
+    m = rng.normal(size=(n, n)).astype(np.float32)
+    P_mat = jnp.asarray(m @ m.T / n + np.eye(n, dtype=np.float32))
+    f32 = jnp.float32
+    prob = BoxQPProblem(
+        q=jnp.asarray(rng.normal(size=n).astype(np.float32)),
+        lo=jnp.full((n,), -1.0, f32), hi=jnp.full((n,), 1.0, f32),
+        E=jnp.ones((1, n), f32), b=jnp.ones((1,), f32),
+        l1=jnp.zeros((), f32), center=jnp.zeros((n,), f32))
+    res_off = admm_solve_dense(P_mat, prob, iters=60)
+    with obs.probing():
+        res_on = admm_solve_dense(P_mat, prob, iters=60)
+    assert res_off.residual_traj is None
+    traj = np.asarray(res_on.residual_traj)
+    assert traj.shape == (3, 3)  # ceil(60 / 25) segments x (prim, dual, rho)
+    assert np.isfinite(traj).all() and (traj[:, 2] > 0).all()
+    np.testing.assert_array_equal(np.asarray(res_on.x),
+                                  np.asarray(res_off.x))
+    # the trajectory probes into a capture like any stage tensor
+    from factormodeling_tpu.obs import probes as P
+
+    with P.capture() as cap:
+        P.probe("solver/admm/residual_traj", res_on.residual_traj,
+                expect_finite=None)
+        frames = cap.frames()
+    assert "solver/admm/residual_traj" in frames
+
+
+def test_compile_telemetry_and_retrace_detector(rng):
+    """instrument_jit attributes compile seconds/counts per entry point,
+    records kind="compile" rows into the active report, and flags a
+    deliberately shape-unstable caller as retraced."""
+    from factormodeling_tpu.obs import compile_log
+
+    before = obs.compile_totals()
+    rep = obs.RunReport("compile-unit")
+    with rep.activate():
+        # a healthy entry point: 2 signatures, 2 compiles, no retrace flag
+        healthy = obs.instrument_jit(jax.jit(lambda x: x * 2 + 1),
+                                     "unit/healthy")
+        healthy(jnp.ones((4,)))
+        healthy(jnp.ones((4,)))          # cache hit
+        healthy(jnp.ones((6,)))          # legitimate new signature
+        assert healthy.compiles == 2 and not healthy.retraced
+
+        # the classic silent-retrace bug: a caller whose shapes never
+        # stabilize, pinned against its declared expectation of ONE shape
+        unstable = obs.instrument_jit(jax.jit(lambda x: (x * x).sum()),
+                                      "unit/unstable",
+                                      expected_signatures=1)
+        for k in range(4):
+            unstable(jnp.ones((3 + k,)))
+        assert unstable.retraced and unstable.retraces == 3
+
+    after = obs.compile_totals()
+    assert after["compiles"] >= before["compiles"] + 6
+    assert after["compile_s"] > before["compile_s"]
+
+    rows = [r for r in rep.rows if r["kind"] == "compile"]
+    assert {r["name"] for r in rows} == {"unit/healthy", "unit/unstable"}
+    last = [r for r in rows if r["name"] == "unit/unstable"][-1]
+    assert last["retraced"] and last["retraces"] == 3
+    assert last["compile_s"] > 0
+    stats = compile_log.compile_stats()
+    assert stats["unit/unstable"]["retraced"]
+
+    # transparent wrapper: jit attributes still resolve through it
+    assert healthy.lower(jnp.ones((4,))) is not None
+
+
+def test_span_error_row_is_marked_unfenced():
+    """A raising span body skips the block_until_ready fence, so its row
+    must report fenced: false (the soundness column in trace_report would
+    otherwise overclaim a crashed stage as soundly timed)."""
+    import pytest
+    import trace_report
+
+    rep = obs.RunReport("err")
+    with pytest.raises(RuntimeError, match="boom"):
+        with rep.span("crashing_stage") as sp:
+            sp.add(jnp.ones((4,)))
+            raise RuntimeError("boom")
+    row = rep.rows[-1]
+    assert row["kind"] == "span" and row["error"] is True
+    assert row["fenced"] is False
+    assert trace_report.unsound_spans(rep.rows) == ["crashing_stage"]
+
+    # a clean span with the same registration stays sound
+    with rep.span("fine_stage") as sp:
+        sp.add(jnp.ones((4,)))
+    assert rep.rows[-1]["fenced"] is True
+
+
 def test_counter_collection_overhead_is_small(rng):
     """Per-day counter collection rides reductions over arrays the step
     already materializes; measured overhead is within run-to-run noise

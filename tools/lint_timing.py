@@ -207,16 +207,23 @@ def lint_paths(paths) -> list[str]:
 
 def default_targets(repo_root=None) -> list[Path]:
     """The timing-sensitive surface: bench.py, every tools/ script (this
-    linter included — it must stay clean against itself), and the backtest
-    driver + solver modules. The latter joined with the turnover-parallel
-    outer-sweep loop (round 8): an iteration driver is exactly where an
-    unfenced host-timing window would be tempting to add and wrong — its
-    sweeps dispatch asynchronously — so the sweep-loop code path stays
-    under rule A permanently."""
+    linter included — it must stay clean against itself), the backtest
+    driver + solver modules, the examples, and the obs layer itself. The
+    backtest/solvers joined with the turnover-parallel outer-sweep loop
+    (round 8): an iteration driver is exactly where an unfenced
+    host-timing window would be tempting to add and wrong — its sweeps
+    dispatch asynchronously. examples/ and factormodeling_tpu/obs/ joined
+    with the compile-telemetry round (round 9): the obs layer is where
+    wall-clock windows are MADE (``obs.span``'s fence-inside-the-window
+    discipline must hold in its own source), and the examples are the
+    copy-paste surface users time their own runs from — both stay under
+    rule A permanently."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))
+            + sorted((root / "examples").glob("*.py"))
             + sorted((pkg / "backtest").glob("*.py"))
+            + sorted((pkg / "obs").glob("*.py"))
             + sorted((pkg / "solvers").glob("*.py")))
 
 

@@ -1,0 +1,100 @@
+"""Diff two ``factormodeling_tpu.obs.RunReport`` JSONLs; exit nonzero on
+regression.
+
+Usage::
+
+    python tools/report_diff.py baseline.jsonl new.jsonl [--wall-ratio 1.5]
+        [--wall-min-s 0.05] [--no-wall] [--finite-tol 1e-6] [--json]
+
+The CI loop this enables: run with ``--report`` (``examples/pipeline.py``,
+``bench.py``, or your own ``RunReport``), keep a known-good report as the
+baseline (``tests/goldens/obs_report_clean.jsonl`` is the committed
+example), and gate merges on this diff — a span that got 1.5x slower, a
+solver-fallback counter that ticked up, a probe stage whose finite
+fraction dropped (the watchdog names the first bad stage), or a silent jit
+retrace all exit 1 with a one-line attribution.
+
+Pure stdlib, no jax: the diff logic lives in
+``factormodeling_tpu/obs/regression.py`` (itself stdlib-only) and is
+loaded standalone by file path, so this tool runs anywhere the JSONLs do —
+same contract as ``tools/trace_report.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+_REG_PATH = (Path(__file__).resolve().parent.parent / "factormodeling_tpu"
+             / "obs" / "regression.py")
+
+
+def _load_regression():
+    """Import obs/regression.py WITHOUT the package __init__ (which pulls
+    jax) so the tool stays runnable on report-only boxes. Same sys.modules
+    key and cache-first semantics as ``trace_report._regression`` — a
+    process importing both tools must see ONE module (re-executing would
+    silently fork the dataclass identities)."""
+    name = "_fmt_obs_regression"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, _REG_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    # register before exec: dataclasses resolves the module's (stringified)
+    # annotations through sys.modules[cls.__module__]
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(name, None)  # never cache a half-initialized module
+        raise
+    return mod
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("baseline", help="known-good RunReport JSONL")
+    parser.add_argument("new", help="fresh RunReport JSONL to gate")
+    parser.add_argument("--wall-ratio", type=float, default=1.5,
+                        help="max new/baseline total wall seconds per span "
+                             "(default 1.5)")
+    parser.add_argument("--wall-min-s", type=float, default=0.05,
+                        help="ignore spans whose baseline total is below "
+                             "this (default 0.05 s)")
+    parser.add_argument("--no-wall", action="store_true",
+                        help="skip wall-clock gating (schema/counters/"
+                             "numerics only — for cross-machine diffs)")
+    parser.add_argument("--counter-tol", type=float, default=1e-9)
+    parser.add_argument("--finite-tol", type=float, default=1e-6,
+                        help="tolerated finite-fraction drop per probe "
+                             "stage (default 1e-6)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the findings as one JSON object instead "
+                             "of text")
+    args = parser.parse_args(argv)
+
+    reg = _load_regression()
+    result = reg.diff_reports(
+        reg.load_jsonl(args.baseline), reg.load_jsonl(args.new),
+        wall_ratio=args.wall_ratio, wall_min_s=args.wall_min_s,
+        check_wall=not args.no_wall, counter_tol=args.counter_tol,
+        finite_tol=args.finite_tol)
+
+    if args.json:
+        print(json.dumps({
+            "ok": result.ok,
+            "first_bad_stage": result.first_bad_stage,
+            "regressions": [f.render() for f in result.regressions],
+            "notes": [f.render() for f in result.findings
+                      if not f.regression],
+        }))
+    else:
+        print(result.render())
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,23 +13,68 @@ that has the JSONL, no jax required.
 from __future__ import annotations
 
 import argparse
-import json
+import importlib.util
 import sys
 from collections import defaultdict
 from pathlib import Path
 
 __all__ = ["load_rows", "render", "main"]
 
+_REG_PATH = (Path(__file__).resolve().parent.parent / "factormodeling_tpu"
+             / "obs" / "regression.py")
+
+
+def _regression():
+    """obs/regression.py loaded standalone (stdlib-only, no package
+    __init__ / jax import) — the one copy of the tolerant JSONL parser,
+    shared with tools/report_diff.py."""
+    name = "_fmt_obs_regression"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, _REG_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        # never cache a half-initialized module: a later caller (or
+        # report_diff, which shares the key) would get AttributeErrors
+        # instead of its own load attempt / fallback
+        sys.modules.pop(name, None)
+        raise
+    return mod
+
 
 def load_rows(paths) -> list[dict]:
-    rows = []
-    for path in paths:
-        with Path(path).open() as fh:
-            for line in fh:
-                line = line.strip()
-                if line:
-                    rows.append(json.loads(line))
-    return rows
+    """Rows from one or more report JSONLs. Unparseable lines — a run
+    killed mid-write truncates its last line — are skipped with a warning
+    naming the file and line number, so a crashed run's partial report
+    still renders (partial evidence is exactly what a report of a broken
+    run is for)."""
+    try:
+        load_jsonl = _regression().load_jsonl
+    except OSError:
+        # this file may be copied alone to a render-only box (the "any box
+        # that has the JSONL" contract) — fall back to an inline parser
+        # with the same skip-with-warning semantics
+        import json
+
+        def load_jsonl(path):
+            rows = []
+            with Path(path).open() as fh:
+                for lineno, line in enumerate(fh, start=1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError as e:
+                        print(f"warning: {path}:{lineno}: skipping "
+                              f"unparseable JSONL line ({e})",
+                              file=sys.stderr)
+            return rows
+
+    return [row for path in paths for row in load_jsonl(path)]
 
 
 def _fmt_table(headers, rows) -> str:
@@ -104,9 +149,61 @@ def _cost_table(rows) -> str | None:
             + _fmt_table(("stage", "flops", "bytes", "note"), body))
 
 
+def _numerics_table(rows) -> str | None:
+    frames = [r for r in rows if r.get("kind") == "numerics"]
+    if not frames:
+        return None
+    body = []
+    for r in sorted(frames, key=lambda r: (r.get("name", ""),
+                                           r.get("seq", 0))):
+        body.append((r.get("name", "?"), r.get("stage", "?"),
+                     f"{float(r.get('finite_frac', float('nan'))):.6g}",
+                     r.get("nan_count", "-"), r.get("inf_count", "-"),
+                     f"{float(r.get('absmax', float('nan'))):.4g}",
+                     f"{float(r.get('mean', float('nan'))):.4g}",
+                     f"{float(r.get('std', float('nan'))):.4g}"))
+    return ("== numerics probes (per-stage tensor summaries, trace order) "
+            "==\n" + _fmt_table(("step", "stage", "finite_frac", "nan",
+                                 "inf", "absmax", "mean", "std"), body))
+
+
+def _watchdog_table(rows) -> str | None:
+    dogs = [r for r in rows if r.get("kind") == "watchdog"]
+    if not dogs:
+        return None
+    body = [(r.get("name", "?"), r.get("mode", "?"),
+             r.get("first_bad_stage") or "-",
+             ",".join(r.get("dropped", [])) or "-")
+            for r in dogs]
+    return ("== numerics watchdog (first stage whose finite fraction "
+            "dropped) ==\n"
+            + _fmt_table(("step", "mode", "first_bad_stage", "dropped"),
+                         body))
+
+
+def _compile_table(rows) -> str | None:
+    comp = [r for r in rows if r.get("kind") == "compile"]
+    if not comp:
+        return None
+    # rows carry cumulative fields; keep the last per entry point
+    last: dict[str, dict] = {}
+    for r in comp:
+        last[r.get("name", "?")] = r
+    body = [(name, r.get("calls", "-"), r.get("compiles", "-"),
+             f"{float(r.get('compile_s', float('nan'))):.4f}",
+             r.get("signatures", "-"),
+             "YES" if r.get("retraced") else "no")
+            for name, r in sorted(last.items())]
+    return ("== compile telemetry (per jit entry point; retraced YES = "
+            "compiled beyond its signature count) ==\n"
+            + _fmt_table(("entry_point", "calls", "compiles", "compile_s",
+                          "signatures", "retraced"), body))
+
+
 def _stage_table(rows) -> str | None:
     stages = [r for r in rows
-              if r.get("kind") not in ("span", "counters", "cost", "bench")]
+              if r.get("kind") not in ("span", "counters", "cost", "bench",
+                                       "numerics", "watchdog", "compile")]
     if not stages:
         return None
     body = []
@@ -144,20 +241,46 @@ def render(rows) -> str:
     head = f"run report: {len(rows)} row(s)" + (
         f", label(s): {', '.join(labels)}" if labels else "")
     sections = [head]
-    for maker in (_span_table, _counter_table, _cost_table, _bench_table,
-                  _stage_table):
+    for maker in (_span_table, _counter_table, _numerics_table,
+                  _watchdog_table, _compile_table, _cost_table,
+                  _bench_table, _stage_table):
         section = maker(rows)
         if section:
             sections.append(section)
     return "\n\n".join(sections)
 
 
+def unsound_spans(rows) -> list[str]:
+    """Span names whose soundness mark is "NO": at least one row neither
+    fenced device outputs nor declared ``sync: "host"`` — its window may
+    have timed async dispatch only (error rows count too: their fence was
+    skipped). The ``--strict`` gate."""
+    bad = set()
+    for r in rows:
+        if (r.get("kind") == "span" and not r.get("fenced")
+                and r.get("sync") != "host"):
+            bad.add(r["name"])
+    return sorted(bad)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("jsonl", nargs="+",
                         help="RunReport JSONL file(s) to render")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit nonzero when any span row is unsound "
+                             "(fenced NO: neither a device fence nor a "
+                             "declared host-synchronous window) — makes "
+                             "the renderer CI-able")
     args = parser.parse_args(argv)
-    print(render(load_rows(args.jsonl)))
+    rows = load_rows(args.jsonl)
+    print(render(rows))
+    if args.strict:
+        bad = unsound_spans(rows)
+        if bad:
+            print(f"strict: {len(bad)} span(s) with fenced == 'NO': "
+                  + ", ".join(bad), file=sys.stderr)
+            return 1
     return 0
 
 
