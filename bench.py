@@ -182,6 +182,31 @@ def _profiled(profile, name):
     return jax.profiler.trace(f"{_TRACE_DIR}/{name}")
 
 
+def _placement_extras(jitted, *args, mesh=None):
+    """``comms_bytes`` / ``peak_mem_bytes`` columns for a bench row: the
+    published wall-clock gets its placement context (estimated collective
+    traffic from the compiled HLO, peak device residency from
+    memory_analysis) so a perf row can't silently trade speed for
+    replication or a fatter temp arena. Costs one FULL extra XLA compile
+    of the kernel (``jitted.lower().compile()`` does not consult the jit
+    dispatch cache — seconds at bench shapes), AFTER the timed window, so
+    the published number is unaffected; never raises — benches publish
+    with a note when a backend won't report."""
+    from factormodeling_tpu.obs import comms as obs_comms
+    from factormodeling_tpu.obs import memory as obs_memory
+
+    try:
+        _, compiled = obs_comms.resolve(jitted, *args)
+        ledger = obs_comms.comms_ledger(compiled, mesh=mesh)
+        peak = obs_memory.peak_bytes(compiled)
+        return {"comms_bytes": round(ledger.totals()["bytes_moved"], 1),
+                "peak_mem_bytes": peak if peak is not None
+                else "unavailable"}
+    except Exception as e:  # pragma: no cover - backend-dependent
+        return {"comms_bytes": f"unavailable: {e}",
+                "peak_mem_bytes": "unavailable"}
+
+
 # ------------------------------------------------- config 0: rank-IC 500x252
 
 
@@ -256,7 +281,8 @@ def bench_rank_ic(smoke=False, profile=False):
                                    f"dispatch incl. the host round trip — "
                                    f"the 500x252 workload is latency-bound, "
                                    f"see rank_ic_batched for the kernel at "
-                                   f"scale"})
+                                   f"scale",
+                           **_placement_extras(step, fd, rd)})
 
 
 # --------------------- config 0b: batched rank-IC at the streaming-chunk shape
@@ -1511,7 +1537,11 @@ def bench_obs_overhead(smoke=False, profile=False):
         extras={"seconds_probes_off": round(min(t_off), 4),
                 "probe_overhead_frac": round(overhead, 4),
                 "acceptance": "probe_overhead_frac <= 0.02",
-                "probe_stages": len(out_on.probes)})
+                "probe_stages": len(out_on.probes),
+                # placement context for the probed step (single device
+                # here, so comms_bytes pins 0 — a nonzero value would
+                # mean the obs layer itself started moving data)
+                **_placement_extras(step_on, *args)})
 
 
 # --------------------------------------------- north star from DISK chunks

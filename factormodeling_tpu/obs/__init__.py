@@ -23,12 +23,23 @@ sections 13 and 15):
   compile listener plus :func:`instrument_jit` wrappers at the jit entry
   points: per-entry-point compile seconds/counts as report rows and a
   silent-retrace detector.
+- :mod:`~factormodeling_tpu.obs.comms` — the post-compile placement
+  ledger: walk the compiled step's HLO for the collectives XLA actually
+  emitted (per-stage counts + byte estimates, per-mesh-axis totals) and
+  lint the actual input/output shardings against the declared
+  PartitionSpecs (``sharding_lint``). Opt in per report with
+  ``RunReport(..., comms=True)`` or call ``add_placement`` explicitly.
+- :mod:`~factormodeling_tpu.obs.memory` — device-memory telemetry:
+  ``compiled.memory_analysis()`` footprints as ``kind="memory"`` rows
+  and live ``device.memory_stats()`` watermarks sampled at span exits
+  (skip-with-reason on backends without them, e.g. CPU).
 - :mod:`~factormodeling_tpu.obs.report` — ``obs.span(...)`` wall timers
   with built-in ``block_until_ready`` fences, and :class:`RunReport`,
   which merges spans, counter summaries, probe frames, compile rows,
-  ``polish_stats``, and ``cost_analysis()`` FLOP/byte estimates into one
-  JSONL artifact (rendered by ``tools/trace_report.py``; two reports diff
-  and gate via :mod:`~factormodeling_tpu.obs.regression` /
+  placement-ledger rows, ``polish_stats``, and ``cost_analysis()``
+  FLOP/byte estimates into one JSONL artifact with a ``kind="meta"``
+  schema/environment header (rendered by ``tools/trace_report.py``; two
+  reports diff and gate via :mod:`~factormodeling_tpu.obs.regression` /
   ``tools/report_diff.py``).
 
 Quickstart::
@@ -47,12 +58,21 @@ Quickstart::
     rep.write_jsonl("run_report.jsonl")
 """
 
-from factormodeling_tpu.obs import regression  # noqa: F401
+from factormodeling_tpu.obs import comms, memory, regression  # noqa: F401
+from factormodeling_tpu.obs.comms import (  # noqa: F401
+    CommsLedger,
+    comms_ledger,
+    sharding_lint,
+)
 from factormodeling_tpu.obs.compile_log import (  # noqa: F401
     InstrumentedJit,
     compile_stats,
     compile_totals,
     instrument_jit,
+)
+from factormodeling_tpu.obs.memory import (  # noqa: F401
+    live_watermark,
+    memory_summary,
 )
 from factormodeling_tpu.obs.counters import (  # noqa: F401
     StageCounters,
@@ -72,6 +92,7 @@ from factormodeling_tpu.obs.probes import (  # noqa: F401
     watchdog,
 )
 from factormodeling_tpu.obs.report import (  # noqa: F401
+    SCHEMA_VERSION,
     RunReport,
     SpanHandle,
     active_report,

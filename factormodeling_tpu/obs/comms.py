@@ -1,0 +1,498 @@
+"""Post-compile placement ledger: the collectives XLA actually emitted.
+
+PR 2's traces say *what ran*, PR 4's probes say *whether the numbers are
+sane* — but both are blind to the distributed dimension: nothing records
+which collectives the GSPMD partitioner inserted into the pjit'd research
+step, how many bytes cross the mesh per pipeline stage, or whether a
+refactor silently replicated a sharded operand (every flop then runs on
+every device and the "mesh speedup" quietly evaporates). This module reads
+the COMPILED artifact — the ground truth the partitioner actually produced
+— and turns it into gateable report rows:
+
+- :func:`parse_collectives` walks the optimized per-device HLO text
+  (``compiled.as_text()``) and extracts every ``all-reduce`` /
+  ``all-gather`` / ``reduce-scatter`` / ``all-to-all`` /
+  ``collective-permute`` (async ``-start`` forms count once; their
+  ``-done`` halves are skipped), with byte estimates from the operand
+  shapes x replica-group sizes and a mesh-axis attribution recovered from
+  the replica groups.
+- Stage attribution rides the ``obs.stage`` named scopes PR 2 already
+  pins into HLO ``op_name`` metadata: a collective whose op_name carries
+  ``selection/rolling`` is charged to that stage, so "the IC stage
+  all-reduces 2.1 MB over the date axis" is readable from the report.
+- :func:`comms_ledger` aggregates the ops into a :class:`CommsLedger`
+  (per-stage counts + bytes, totals per collective kind and mesh axis)
+  whose :meth:`CommsLedger.rows` become ``kind="comms"`` RunReport rows.
+- :func:`sharding_lint` compares the compiled step's ACTUAL input/output
+  shardings against the declared :class:`~jax.sharding.PartitionSpec`s
+  (``parallel/mesh.py``'s canonical specs, threaded through
+  ``make_sharded_research_step``), flagging XLA-inserted resharding and
+  unintended replication — the regression every ``ok=true`` smoke test
+  misses.
+
+Byte-estimate model (indicative, not measured traffic): for a collective
+over groups of size S, the per-participant link bytes are
+``factor(kind, S) x operand_bytes`` with the standard ring/butterfly
+factors — all-reduce ``2(S-1)/S``, all-gather ``S-1`` (the operand is the
+local shard), reduce-scatter and all-to-all ``(S-1)/S``, permute ``1`` —
+and ``bytes_moved`` totals that over every participant
+(``n_groups x S``). Shapes come from the per-device HLO, so they are
+already per-shard. Limits: a collective inside a ``while`` body counts
+ONCE (static op count, not dynamic trip count), and the model ignores
+topology (ICI vs DCN hops cost the same byte). docs/architecture.md §16.
+
+Everything here is testable on the tier-1 CPU mesh: with
+``--xla_force_host_platform_device_count=8`` XLA emits the same
+collectives it would on real chips.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import NamedTuple
+
+import numpy as np
+
+__all__ = ["CollectiveOp", "CommsLedger", "STAGE_SCOPES", "comms_ledger",
+           "hlo_text_of", "mesh_of", "parse_collectives", "resolve",
+           "sharding_lint"]
+
+#: the canonical ``obs.stage`` scopes collectives are attributed to — the
+#: OUTERMOST matching scope wins (op_names nest, e.g.
+#: ``selection/rolling/selection/daily_stats/...``), so per-stage buckets
+#: line up with the pipeline stages the span/counter rows already use.
+STAGE_SCOPES = (
+    "selection/rolling", "selection/daily_stats", "selection/rolling_metrics",
+    "composite/blend", "backtest/trade_list", "backtest/weights",
+    "backtest/pnl", "pipeline/summary", "obs/stage_counters",
+    "solver/admm", "solver/polish", "metrics/rank_ic",
+    "streaming/stats", "streaming/composite", "streaming/linear_research",
+    "sweep/books", "sweep/combo_pnl",
+)
+
+_KINDS = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+          "collective-permute")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1,
+    "f8e5m2": 1, "f8e4m3b11fnuz": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+#: per-participant link-bytes factor as a function of group size S (see
+#: the module-docstring byte model)
+_BYTE_FACTOR = {
+    "all-reduce": lambda s: 2.0 * (s - 1) / s if s else 0.0,
+    "all-gather": lambda s: float(s - 1),
+    "reduce-scatter": lambda s: (s - 1) / s if s else 0.0,
+    "all-to-all": lambda s: (s - 1) / s if s else 0.0,
+    "collective-permute": lambda s: 1.0,
+}
+
+
+class CollectiveOp(NamedTuple):
+    """One collective extracted from the compiled per-device HLO."""
+
+    kind: str            # one of _KINDS (async -start normalized away)
+    stage: str           # attributed obs.stage scope, or "unattributed"
+    axis: str            # mesh axis the groups span ("date", "factor",
+    #                      "factor+date" for full-mesh, "mixed", "unknown")
+    operand_bytes: int   # per-participant payload (per-device HLO shapes)
+    bytes_moved: float   # mesh-wide estimate: factor(kind,S) x payload
+    #                      x participants
+    group_size: int
+    n_groups: int
+    op_name: str         # full HLO op_name metadata, for drill-down
+
+
+# ------------------------------------------------------------- HLO access
+
+
+def hlo_text_of(target, *args, **kwargs) -> str:
+    """Optimized HLO text of ``target``: a string passes through, a
+    ``Compiled`` renders itself, a ``Lowered`` compiles first (cached by
+    jax on identical modules), and a jit wrapper lowers at ``*args``.
+    This is the ONE accessor every ledger path goes through — the
+    ledger-off elision test stubs it to prove a disabled report never
+    walks HLO."""
+    if isinstance(target, str):
+        return target
+    _, compiled = resolve(target, *args, **kwargs)
+    return compiled.as_text()
+
+
+def resolve(target, *args, **kwargs):
+    """(lowered_or_None, compiled) for a Compiled / Lowered / jit-like
+    target. The lowered handle (when available) additionally carries
+    ``out_info`` shapes for the output-side sharding lint."""
+    if hasattr(target, "as_text") and not hasattr(target, "compile"):
+        return None, target                      # already Compiled
+    if hasattr(target, "compile"):               # Lowered
+        return target, target.compile()
+    if hasattr(target, "lower"):                 # jit / InstrumentedJit
+        lowered = target.lower(*args, **kwargs)
+        return lowered, lowered.compile()
+    raise TypeError(f"cannot resolve HLO from {type(target).__name__}; "
+                    f"pass HLO text, a Compiled, a Lowered, or a jit "
+                    f"wrapper with its call args")
+
+
+def mesh_of(compiled):
+    """The jax Mesh recoverable from a compiled step's NamedShardings
+    (first one found over inputs then outputs), or None — lets
+    ``add_placement`` attribute axes without the caller re-passing the
+    mesh."""
+    import jax
+
+    ins, _ = compiled.input_shardings
+    for s in jax.tree_util.tree_leaves(ins):
+        if hasattr(s, "mesh"):
+            return s.mesh
+    for s in jax.tree_util.tree_leaves(compiled.output_shardings):
+        if hasattr(s, "mesh"):
+            return s.mesh
+    return None
+
+
+# ------------------------------------------------------------- HLO parse
+
+
+_OP_RE = re.compile(
+    r"=\s+\(?\s*[a-z][a-z0-9]*\[[^\]]*\]"      # result type (tuple's first)
+    r".*?\s("                                   # ... then the op kind
+    + "|".join(_KINDS) + r")(-start)?\(")
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(\{[0-9,{} ]*\})\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d.strip():
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _operand_bytes(line: str, start: int) -> int:
+    """Sum of operand-array bytes: the shapes inside the op's argument
+    parens (depth-matched so ``to_apply=...`` clauses after the close
+    paren never leak in)."""
+    depth, end = 0, len(line)
+    for i in range(start, len(line)):
+        ch = line[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    seg = line[start:end]
+    return sum(_shape_bytes(d, dims) for d, dims in _SHAPE_RE.findall(seg))
+
+
+def _parse_groups(line: str):
+    """Replica groups as a list of int tuples, from either HLO syntax:
+    explicit ``{{0,1},{2,3}}`` or iota ``[G,S]<=[dims]T(perm)`` (arange
+    over dims, transposed by perm, reshaped to G x S)."""
+    m = _EXPLICIT_GROUPS_RE.search(line)
+    if m:
+        return [tuple(int(x) for x in g.split(",") if x.strip())
+                for g in re.findall(r"\{([0-9, ]*)\}", m.group(1))]
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(x) for x in m.group(4).split(",")])
+        return [tuple(int(x) for x in row) for row in ids.reshape(g, s)]
+    m = _PAIRS_RE.search(line)
+    if m:  # collective-permute: each (source, target) pair is a "group"
+        return [tuple(int(x) for x in p.split(","))
+                for p in re.findall(r"\{(\d+,\d+)\}", m.group(1))]
+    return []
+
+
+def _mesh_axis_ids(mesh):
+    """(axis_names, int ndarray of device ids) from a jax Mesh or a
+    ``{axis: size}`` dict (row-major ids, the ``make_mesh`` layout)."""
+    if mesh is None:
+        return None
+    if hasattr(mesh, "devices") and hasattr(mesh, "axis_names"):
+        ids = np.array([getattr(d, "id", d) for d in mesh.devices.ravel()],
+                       dtype=np.int64).reshape(mesh.devices.shape)
+        return tuple(mesh.axis_names), ids
+    sizes = [int(v) for v in dict(mesh).values()]
+    return (tuple(dict(mesh)),
+            np.arange(int(np.prod(sizes)), dtype=np.int64).reshape(sizes))
+
+
+def _axis_of(groups, kind: str, axes) -> str:
+    """Which mesh axis (or combination) the replica groups span."""
+    if axes is None or not groups:
+        return "unknown"
+    names, ids = axes
+    if kind == "collective-permute":
+        # each pair should differ along exactly one mesh axis
+        pos = {int(v): np.unravel_index(i, ids.shape)
+               for i, v in enumerate(ids.ravel())}
+        hit: set = set()
+        for s, t in groups:
+            if s not in pos or t not in pos:
+                return "unknown"
+            diff = [names[k] for k in range(ids.ndim)
+                    if pos[s][k] != pos[t][k]]
+            hit.update(diff or ["none"])
+        return hit.pop() if len(hit) == 1 else "mixed"
+    got = frozenset(frozenset(g) for g in groups)
+    for k, name in enumerate(names):
+        rows = np.moveaxis(ids, k, -1).reshape(-1, ids.shape[k])
+        if got == frozenset(frozenset(int(x) for x in r) for r in rows):
+            return name
+    if got == frozenset([frozenset(int(x) for x in ids.ravel())]):
+        return "+".join(names)
+    return "mixed"
+
+
+def _stage_of(op_name: str, stages) -> str:
+    """The OUTERMOST (earliest-position) matching stage scope; ties at one
+    position prefer the LONGEST scope, so a scope that extends another
+    (``selection/rolling_metrics`` vs ``selection/rolling``) wins when it
+    is the one actually present rather than being shadowed by its
+    prefix."""
+    best, best_key = "unattributed", (len(op_name) + 1, 0)
+    for scope in stages:
+        pos = op_name.find(scope)
+        if pos >= 0 and (pos, -len(scope)) < best_key:
+            best, best_key = scope, (pos, -len(scope))
+    return best
+
+
+def parse_collectives(hlo_text: str, *, stages=STAGE_SCOPES,
+                      mesh=None) -> list[CollectiveOp]:
+    """Every collective in the optimized per-device HLO text (see module
+    docs for the byte model and its limits). ``mesh`` (a jax Mesh or an
+    ``{axis: size}`` dict) enables mesh-axis attribution of the replica
+    groups; without it the axis is "unknown"."""
+    axes = _mesh_axis_ids(mesh)
+    ops: list[CollectiveOp] = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        payload = _operand_bytes(line, m.end() - 1)
+        groups = _parse_groups(line)
+        if kind == "collective-permute":
+            n_groups, size = len(groups), 2
+            participants = max(len(groups), 1)
+        else:
+            n_groups = max(len(groups), 1)
+            size = len(groups[0]) if groups else 0
+            participants = n_groups * max(size, 1)
+        per_device = _BYTE_FACTOR[kind](size if size else 1) * payload
+        nm = re.search(r'op_name="([^"]*)"', line)
+        op_name = nm.group(1) if nm else ""
+        ops.append(CollectiveOp(
+            kind=kind, stage=_stage_of(op_name, stages),
+            axis=_axis_of(groups, kind, axes), operand_bytes=payload,
+            bytes_moved=per_device * participants, group_size=size,
+            n_groups=n_groups, op_name=op_name))
+    return ops
+
+
+# --------------------------------------------------------------- ledger
+
+
+class CommsLedger:
+    """Aggregated collective-comms accounting for one compiled artifact."""
+
+    def __init__(self, ops: list, mesh_shape: dict | None = None):
+        self.ops = list(ops)
+        self.mesh_shape = dict(mesh_shape) if mesh_shape else None
+
+    def by_stage(self) -> dict:
+        """stage -> {"collectives": {kind: {count, bytes_moved}},
+        "bytes_moved": total} in first-appearance order."""
+        out: dict = {}
+        for op in self.ops:
+            bucket = out.setdefault(op.stage,
+                                    {"collectives": {}, "bytes_moved": 0.0})
+            k = bucket["collectives"].setdefault(
+                op.kind, {"count": 0, "bytes_moved": 0.0})
+            k["count"] += 1
+            k["bytes_moved"] += op.bytes_moved
+            bucket["bytes_moved"] += op.bytes_moved
+        return out
+
+    def totals(self) -> dict:
+        by_kind: dict = {}
+        by_axis: dict = {}
+        for op in self.ops:
+            k = by_kind.setdefault(op.kind, {"count": 0, "bytes_moved": 0.0})
+            k["count"] += 1
+            k["bytes_moved"] += op.bytes_moved
+            by_axis[op.axis] = by_axis.get(op.axis, 0.0) + op.bytes_moved
+        return {"collectives": len(self.ops),
+                "bytes_moved": sum(op.bytes_moved for op in self.ops),
+                "by_kind": by_kind, "by_axis": by_axis}
+
+    def rows(self, name: str) -> list[dict]:
+        """``kind="comms"`` RunReport rows: one per attributed stage plus
+        a ``stage="total"`` roll-up carrying the per-axis byte split."""
+        rows = [{"kind": "comms", "name": name, "stage": stage, **agg}
+                for stage, agg in self.by_stage().items()]
+        total = self.totals()
+        rows.append({"kind": "comms", "name": name, "stage": "total",
+                     "collectives": total["by_kind"],
+                     "bytes_moved": total["bytes_moved"],
+                     "by_axis": total["by_axis"],
+                     "mesh_shape": self.mesh_shape})
+        return rows
+
+
+def comms_ledger(target, *args, stages=STAGE_SCOPES, mesh=None,
+                 **kwargs) -> CommsLedger:
+    """The :class:`CommsLedger` of a compiled artifact (or HLO text, or a
+    jit wrapper + its call args). ``mesh`` defaults to the one recovered
+    from the compiled shardings when available."""
+    if isinstance(target, str):
+        text, compiled = target, None
+    else:
+        _, compiled = resolve(target, *args, **kwargs)
+        text = hlo_text_of(compiled)
+    if mesh is None and compiled is not None:
+        mesh = mesh_of(compiled)
+    shape = None
+    if mesh is not None:
+        shape = (dict(mesh.shape) if hasattr(mesh, "shape")
+                 and hasattr(mesh, "axis_names") else dict(mesh))
+    return CommsLedger(parse_collectives(text, stages=stages, mesh=mesh),
+                       mesh_shape=shape)
+
+
+# --------------------------------------------------------------- lint
+
+
+def _spec_dims(sharding):
+    """Normalized PartitionSpec dims (trailing Nones stripped) of a
+    NamedSharding, or None when the sharding carries no spec (GSPMD)."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    dims = tuple(tuple(d) if isinstance(d, (tuple, list)) else d
+                 for d in tuple(spec))
+    while dims and dims[-1] is None:
+        dims = dims[:-1]
+    return dims
+
+
+def _is_replicated(sharding) -> bool:
+    flag = getattr(sharding, "is_fully_replicated", None)
+    if flag is not None:
+        return bool(flag)
+    return _spec_dims(sharding) == ()
+
+
+def sharding_lint(compiled, *, declared_in_shardings=None, lowered=None,
+                  mesh=None) -> dict:
+    """Compare the compiled step's actual shardings against the declared
+    intent.
+
+    Inputs: each actual input sharding is checked against the declared
+    one (``make_sharded_research_step`` threads its ``in_shardings``
+    through as ``declared_in_shardings``). A ``None`` actual sharding
+    means XLA pruned the argument (DCE) — noted, never flagged. A
+    fully-replicated actual against a sharded declaration flags
+    ``replicated``; any other spec mismatch flags ``resharded`` (XLA
+    inserted a layout/sharding change at the boundary).
+
+    Outputs: when ``lowered`` is given (its ``out_info`` carries shapes),
+    any >=2-D, >1-element output leaf that came out FULLY REPLICATED
+    while the program is genuinely distributed (some input is sharded
+    across a >1-device mesh) flags ``replicated output`` — the classic
+    silent full-replication regression. Scalar summaries legitimately
+    replicate and are ignored.
+
+    Returns a JSON-ready dict: ``clean``, ``flags`` (strings),
+    ``notes``, ``checked_inputs``/``checked_outputs``.
+    """
+    import jax
+
+    flags: list[str] = []
+    notes: list[str] = []
+    ins, _ = compiled.input_shardings
+    actual_in = jax.tree_util.tree_leaves(
+        ins, is_leaf=lambda x: x is None)
+    declared = (jax.tree_util.tree_leaves(
+        declared_in_shardings, is_leaf=lambda x: x is None)
+        if declared_in_shardings is not None else [None] * len(actual_in))
+    if len(declared) != len(actual_in):
+        notes.append(f"declared {len(declared)} input shardings for "
+                     f"{len(actual_in)} compiled inputs; input lint skipped")
+        declared = [None] * len(actual_in)
+    checked_in = 0
+    for i, (act, dec) in enumerate(zip(actual_in, declared)):
+        if dec is None:
+            continue
+        if act is None:
+            notes.append(f"input {i}: pruned by XLA (unused); declared "
+                         f"{_spec_dims(dec)} not checkable")
+            continue
+        checked_in += 1
+        d_dims, a_dims = _spec_dims(dec), _spec_dims(act)
+        if d_dims == a_dims:
+            continue
+        if _is_replicated(act) and not _is_replicated(dec):
+            flags.append(f"input {i}: declared {d_dims} but compiled "
+                         f"REPLICATED — every device holds (and computes "
+                         f"on) the full operand")
+        else:
+            flags.append(f"input {i}: declared {d_dims} but compiled "
+                         f"{a_dims} — XLA resharded at the boundary")
+
+    n_devices = 1
+    if mesh is not None and hasattr(mesh, "devices"):
+        n_devices = int(mesh.devices.size)
+    elif mesh is not None:
+        n_devices = int(np.prod([int(v) for v in dict(mesh).values()]))
+    else:
+        for s in actual_in:
+            if s is not None and hasattr(s, "mesh"):
+                n_devices = int(s.mesh.devices.size)
+                break
+    distributed = n_devices > 1 and any(
+        s is not None and not _is_replicated(s) for s in actual_in)
+
+    checked_out = 0
+    if lowered is not None and hasattr(lowered, "out_info") and distributed:
+        infos = jax.tree_util.tree_leaves(lowered.out_info)
+        out_paths = jax.tree_util.tree_flatten_with_path(
+            compiled.output_shardings)[0]
+        if len(infos) == len(out_paths):
+            for info, (path, sh) in zip(infos, out_paths):
+                shape = tuple(getattr(info, "shape", ()))
+                if len(shape) < 2 or int(np.prod(shape)) <= 1:
+                    continue
+                checked_out += 1
+                if _is_replicated(sh):
+                    label = jax.tree_util.keystr(path)
+                    flags.append(
+                        f"output {label} {shape}: fully REPLICATED on a "
+                        f"{n_devices}-device mesh — partitioner fell back "
+                        f"to replication")
+        else:  # pragma: no cover - mismatched trees on exotic backends
+            notes.append("out_info/output_shardings leaf mismatch; "
+                         "output lint skipped")
+    elif not distributed:
+        notes.append("program is not distributed (single device or fully "
+                     "replicated inputs); output replication not judged")
+
+    return {"clean": not flags, "flags": flags, "notes": notes,
+            "checked_inputs": checked_in, "checked_outputs": checked_out,
+            "n_devices": n_devices}

@@ -38,7 +38,7 @@ from typing import Any
 
 import jax
 
-from factormodeling_tpu.obs.report import record_stage
+from factormodeling_tpu.obs.report import active_report, record_stage
 
 __all__ = ["InstrumentedJit", "compile_stats", "compile_totals",
            "entry_point_tag", "install", "instrument_jit",
@@ -268,6 +268,23 @@ class InstrumentedJit:
             st.compiles += new
             st.compile_s += _totals["compile_s"] - s0
             record_stage(self.name, kind="compile", **st.as_dict())
+            # placement ledger (opt-in per report): a call that compiled
+            # is the moment the entry point's collectives/memory/sharding
+            # became knowable, so contribute them here — for EVERY
+            # instrumented entry point (research step, streaming kernels,
+            # compat kernels, sweeps) with no per-site wiring. Costs one
+            # extra AOT lowering+compile of the same module (jax caches
+            # repeats; the secondary compile lands in compile_totals()
+            # but, happening outside any wrapped call window, never in
+            # per-entry-point counts — it cannot fake a retrace). With
+            # comms off (the default) this is one attribute read.
+            rep = active_report()
+            if rep is not None and getattr(rep, "comms", False):
+                rep.add_placement(
+                    self.name, self._fn, *args,
+                    declared_in_shardings=getattr(
+                        self, "declared_in_shardings", None),
+                    mesh=getattr(self, "mesh", None), **kwargs)
         return out
 
     @property

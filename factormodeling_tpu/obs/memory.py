@@ -1,0 +1,121 @@
+"""Device-memory telemetry: compiled footprint estimates and live
+watermarks.
+
+Two complementary sources, both optional per backend:
+
+- **Compiled footprint** (:func:`memory_summary`): XLA's
+  ``compiled.memory_analysis()`` — argument / output / temp / alias
+  bytes of one executable. This is the *static* answer to "will this
+  step fit?" and the gateable one: a refactor that doubles the temp
+  arena shows up here deterministically, before any OOM. Backends that
+  do not implement it fall back to the ``cost_analysis()`` byte totals
+  (traffic, not residency — clearly labeled), and failing that the
+  summary records the reason instead of raising.
+- **Live watermarks** (:func:`live_watermark`): ``device.memory_stats()``
+  gauges (``bytes_in_use`` / ``peak_bytes_in_use``) sampled at span
+  boundaries so the report carries the HBM high-water mark of the run
+  that actually executed. CPU backends report no memory stats — the
+  first probe caches that verdict (:func:`watermark_unavailable_reason`)
+  and every later call is a cheap None, so ``obs.span`` stays free on
+  tier-1.
+
+``RunReport.add_placement`` merges the footprint into ``kind="memory"``
+rows next to the comms ledger; ``tools/report_diff.py`` gates peak-byte
+growth the same GATE_UP way it gates collective counts.
+"""
+
+from __future__ import annotations
+
+__all__ = ["live_watermark", "memory_summary", "peak_bytes",
+           "watermark_unavailable_reason"]
+
+# tri-state: None = not probed yet, "" = available, str = unavailable why
+_WATERMARK_REASON: "str | None" = None
+
+
+def memory_summary(compiled) -> dict:
+    """JSON-ready footprint of one compiled executable.
+
+    With ``memory_analysis()`` support: ``argument_bytes``,
+    ``output_bytes``, ``temp_bytes``, ``alias_bytes``,
+    ``generated_code_bytes``, and the derived ``peak_bytes``
+    (argument + output + temp - alias: the residency estimate gated by
+    ``report_diff``), all under ``source: "memory_analysis"``. Without
+    it: the ``cost_analysis()`` ``bytes accessed`` total as
+    ``bytes_accessed`` under ``source: "cost_analysis"`` (traffic, not
+    residency). When neither works the dict carries ``source: None`` and
+    the ``reason``.
+    """
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, (list, tuple)):  # per-device on some backends
+            ma = ma[0] if ma else None
+        if ma is not None and hasattr(ma, "temp_size_in_bytes"):
+            arg = int(ma.argument_size_in_bytes)
+            out = int(ma.output_size_in_bytes)
+            tmp = int(ma.temp_size_in_bytes)
+            alias = int(ma.alias_size_in_bytes)
+            return {"source": "memory_analysis",
+                    "argument_bytes": arg, "output_bytes": out,
+                    "temp_bytes": tmp, "alias_bytes": alias,
+                    "generated_code_bytes":
+                        int(ma.generated_code_size_in_bytes),
+                    "peak_bytes": arg + out + tmp - alias}
+    except Exception as e:
+        reason = f"memory_analysis failed: {e}"
+    else:
+        reason = "memory_analysis returned no stats"
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        accessed = float(dict(ca or {}).get("bytes accessed", float("nan")))
+        if accessed == accessed:
+            return {"source": "cost_analysis", "bytes_accessed": accessed,
+                    "reason": reason}
+    except Exception as e:  # pragma: no cover - backend-dependent
+        reason = f"{reason}; cost_analysis failed: {e}"
+    return {"source": None, "reason": reason}
+
+
+def peak_bytes(compiled) -> "int | None":
+    """The gateable peak-residency estimate of one executable, or None
+    when the backend reports no memory analysis (bench convenience)."""
+    return memory_summary(compiled).get("peak_bytes")
+
+
+def live_watermark() -> "dict | None":
+    """Current device-memory gauges, or None where the backend provides
+    none (CPU). ``{"bytes_in_use": sum, "peak_bytes_in_use": max,
+    "devices": n}`` over the addressable devices. The first unavailable
+    probe caches its reason; later calls return None immediately."""
+    global _WATERMARK_REASON
+    if _WATERMARK_REASON:  # cached "unavailable" verdict
+        return None
+    import jax
+
+    in_use, peak, n = 0, 0, 0
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # pragma: no cover - backend quirk
+            stats = None
+        if not stats or "bytes_in_use" not in stats:
+            _WATERMARK_REASON = (f"backend '{d.platform}' reports no "
+                                 f"memory_stats")
+            return None
+        in_use += int(stats["bytes_in_use"])
+        peak = max(peak, int(stats.get("peak_bytes_in_use",
+                                       stats["bytes_in_use"])))
+        n += 1
+    if n == 0:  # pragma: no cover - no devices
+        _WATERMARK_REASON = "no local devices"
+        return None
+    _WATERMARK_REASON = ""
+    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak, "devices": n}
+
+
+def watermark_unavailable_reason() -> "str | None":
+    """Why live watermarks are skipped (None until probed / when they
+    work) — the skip-with-reason the memory rows record on CPU."""
+    return _WATERMARK_REASON or None

@@ -24,6 +24,23 @@ flags):
   was this NaN born?". NaN/Inf count increases on a stage with an intact
   finite fraction are informational (a bigger tensor can carry more
   legitimate NaN).
+- **meta** — reports carry a ``kind="meta"`` header (schema version,
+  backend, device kind/count). MISMATCHED SCHEMA VERSIONS REFUSE to gate
+  (one regression finding, nothing else judged — half the rows would be
+  incomparable); a backend/device-kind mismatch is warned and disables
+  wall gating (cross-machine wall ratios gate container speed, not code).
+- **comms** (placement ledger, PR 5) — per (entry point, stage): a
+  collective KIND whose count increased is a regression (HLO op counts
+  are deterministic; a new all-gather means the partitioner now moves
+  data it didn't), and ``bytes_moved`` growth beyond ``comms_ratio`` with
+  at least ``comms_min_bytes`` of absolute growth is a regression; byte
+  shrinkage and brand-new ledger rows are notes (re-baseline to gate).
+- **memory** — per entry point, ``peak_bytes`` growth beyond
+  ``mem_ratio`` with at least ``mem_min_bytes`` absolute growth is a
+  regression; a vanished memory row is a schema regression.
+- **sharding** — a lint row that is no longer ``clean`` (or whose flag
+  count grew) against a clean baseline is a regression: XLA started
+  replicating or resharding something it didn't before.
 
 Deliberately **pure stdlib** with no package-relative imports:
 ``tools/report_diff.py`` loads this file standalone (importlib by path) so
@@ -39,8 +56,9 @@ import sys
 from collections import defaultdict
 from pathlib import Path
 
-__all__ = ["DiffResult", "Finding", "GATE_UP", "counter_scalars",
-           "diff_reports", "load_jsonl", "numerics_baseline", "span_totals"]
+__all__ = ["DiffResult", "Finding", "GATE_UP", "comms_rows",
+           "counter_scalars", "diff_reports", "load_jsonl", "memory_rows",
+           "meta_row", "numerics_baseline", "sharding_rows", "span_totals"]
 
 #: counter keys whose INCREASE is a regression (everything else drifts
 #: informationally). Nested mean/max counters gate on their "mean" leaf.
@@ -156,17 +174,80 @@ def compile_rows(rows) -> dict:
     return {r["name"]: r for r in rows if r.get("kind") == "compile"}
 
 
+def meta_row(rows) -> "dict | None":
+    """The report's ``kind="meta"`` header row, or None (pre-PR-5
+    reports have none and still diff — every meta check degrades to a
+    note)."""
+    for r in rows:
+        if r.get("kind") == "meta":
+            return r
+    return None
+
+
+def comms_rows(rows) -> dict:
+    """(entry_point_name, stage) -> comms row (last occurrence wins;
+    error rows — ledger collection failures — are excluded from
+    gating)."""
+    return {(r.get("name", ""), r.get("stage", "")): r for r in rows
+            if r.get("kind") == "comms" and "error" not in r}
+
+
+def memory_rows(rows) -> dict:
+    """name -> last memory row."""
+    return {r.get("name", ""): r for r in rows if r.get("kind") == "memory"}
+
+
+def sharding_rows(rows) -> dict:
+    """name -> last sharding-lint row."""
+    return {r.get("name", ""): r for r in rows
+            if r.get("kind") == "sharding"}
+
+
 # ------------------------------------------------------------------ diff
 
 
 def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
                  wall_min_s: float = 0.05, check_wall: bool = True,
                  counter_tol: float = 1e-9,
-                 finite_tol: float = 1e-6) -> DiffResult:
+                 finite_tol: float = 1e-6,
+                 comms_ratio: float = 1.5,
+                 comms_min_bytes: float = 1024.0,
+                 mem_ratio: float = 1.5,
+                 mem_min_bytes: float = 1 << 20) -> DiffResult:
     """Compare a fresh report against a known-good baseline (see module
     docs for the checks). Returns a :class:`DiffResult`; ``not result.ok``
     means gate-failing regressions were found."""
     findings: list = []
+
+    # ---- meta header: refuse mismatched schemas, warn on cross-backend
+    base_m, new_m = meta_row(base_rows), meta_row(new_rows)
+    if base_m is not None and new_m is not None:
+        b_ver, n_ver = base_m.get("schema_version"), new_m.get("schema_version")
+        if b_ver != n_ver:
+            return DiffResult(findings=[Finding(
+                "schema", "schema_version",
+                f"baseline schema {b_ver} vs new {n_ver} — refusing to "
+                f"gate incomparable reports (regenerate the baseline)",
+                regression=True)])
+        for key in ("backend", "device_kind"):
+            if base_m.get(key) != new_m.get(key):
+                findings.append(Finding(
+                    "schema", key,
+                    f"baseline {base_m.get(key)!r} vs new "
+                    f"{new_m.get(key)!r} — cross-backend diff; wall "
+                    f"gating disabled (machine speed is not a code "
+                    f"regression)"))
+                check_wall = False
+        for key in ("jax_version", "device_count", "mesh_shape"):
+            if base_m.get(key) != new_m.get(key):
+                findings.append(Finding(
+                    "schema", key, f"baseline {base_m.get(key)!r} vs new "
+                                   f"{new_m.get(key)!r}"))
+    elif (base_m is None) != (new_m is None):
+        findings.append(Finding(
+            "schema", "meta",
+            "only one report carries a kind=\"meta\" header (pre-PR-5 "
+            "baseline?) — environment compatibility not checkable"))
 
     # ---- spans
     base_spans, new_spans = span_totals(base_rows), span_totals(new_rows)
@@ -275,5 +356,114 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
                 "counter", f"{name}/retraces",
                 f"{base_retr} -> {new_retr} silent retraces",
                 regression=True))
+
+    # ---- comms ledger: collective counts gate UP, bytes gate on ratio
+    base_cm, new_cm = comms_rows(base_rows), comms_rows(new_rows)
+    for (name, stage), base_row in sorted(base_cm.items()):
+        label = f"{name}/{stage}"
+        new_row = new_cm.get((name, stage))
+        if new_row is None:
+            findings.append(Finding(
+                "comms", label, "comms ledger row present in baseline, "
+                "missing in new report", regression=True))
+            continue
+        base_c = base_row.get("collectives") or {}
+        new_c = new_row.get("collectives") or {}
+        for kind in sorted(set(base_c) | set(new_c)):
+            b = int((base_c.get(kind) or {}).get("count", 0))
+            n = int((new_c.get(kind) or {}).get("count", 0))
+            if n > b:
+                findings.append(Finding(
+                    "comms", f"{label}/{kind}",
+                    f"collective count {b} -> {n} — the partitioner now "
+                    f"emits {'new' if b == 0 else 'more'} {kind} ops "
+                    f"here", regression=True))
+            elif n < b:
+                findings.append(Finding(
+                    "comms", f"{label}/{kind}",
+                    f"collective count {b} -> {n} (improvement or "
+                    f"restructure — re-baseline to gate it)"))
+        b_bytes = float(base_row.get("bytes_moved", 0.0))
+        n_bytes = float(new_row.get("bytes_moved", 0.0))
+        growth = n_bytes - b_bytes
+        if growth > comms_min_bytes and (
+                b_bytes <= 0 or n_bytes / b_bytes > comms_ratio):
+            findings.append(Finding(
+                "comms", label,
+                f"estimated comms bytes {b_bytes:.4g} -> {n_bytes:.4g} "
+                f"(+{growth:.4g}, > {comms_ratio:g}x tolerance)",
+                regression=True))
+        elif growth < -comms_min_bytes:
+            findings.append(Finding(
+                "comms", label,
+                f"estimated comms bytes {b_bytes:.4g} -> {n_bytes:.4g} "
+                f"(improvement or restructure — re-baseline to gate it)"))
+    for (name, stage) in sorted(set(new_cm) - set(base_cm)):
+        findings.append(Finding(
+            "comms", f"{name}/{stage}",
+            "ledger row absent from baseline (new entry point/stage) — "
+            "re-baseline to gate it"))
+
+    # ---- memory: peak-residency growth gates on ratio + absolute floor
+    base_mm, new_mm = memory_rows(base_rows), memory_rows(new_rows)
+    for name, base_row in sorted(base_mm.items()):
+        new_row = new_mm.get(name)
+        if new_row is None:
+            findings.append(Finding(
+                "memory", name, "memory row present in baseline, missing "
+                "in new report", regression=True))
+            continue
+        b_peak, n_peak = base_row.get("peak_bytes"), new_row.get("peak_bytes")
+        if isinstance(b_peak, (int, float)) \
+                and not isinstance(n_peak, (int, float)):
+            # the gate must not silently disarm: a backend change that
+            # drops memory_analysis turns every later real peak blowup
+            # invisible unless the loss itself is flagged
+            findings.append(Finding(
+                "memory", name,
+                f"baseline carries peak_bytes but the new report does not "
+                f"(source {base_row.get('source')!r} -> "
+                f"{new_row.get('source')!r}) — peak-memory gating "
+                f"disarmed; re-baseline deliberately if intended",
+                regression=True))
+            continue
+        if not isinstance(b_peak, (int, float)) \
+                or not isinstance(n_peak, (int, float)):
+            continue  # neither side gateable (cost_analysis fallback)
+        growth = float(n_peak) - float(b_peak)
+        if growth > mem_min_bytes and (
+                b_peak <= 0 or n_peak / b_peak > mem_ratio):
+            findings.append(Finding(
+                "memory", name,
+                f"peak device bytes {b_peak:.4g} -> {n_peak:.4g} "
+                f"(+{growth:.4g}, > {mem_ratio:g}x tolerance)",
+                regression=True))
+        elif abs(growth) > 0:
+            findings.append(Finding(
+                "memory", name,
+                f"peak device bytes {b_peak:.4g} -> {n_peak:.4g} "
+                f"(within tolerance)"))
+
+    # ---- sharding lint: losing cleanliness against a clean baseline
+    base_sh, new_sh = sharding_rows(base_rows), sharding_rows(new_rows)
+    for name, new_row in sorted(new_sh.items()):
+        base_row = base_sh.get(name, {})
+        base_flags = len(base_row.get("flags") or [])
+        new_flags = len(new_row.get("flags") or [])
+        if new_flags > base_flags:
+            detail = "; ".join((new_row.get("flags") or [])[:3])
+            findings.append(Finding(
+                "sharding", name,
+                f"lint flags {base_flags} -> {new_flags}: {detail}",
+                regression=True))
+        elif new_flags and base_flags:
+            findings.append(Finding(
+                "sharding", name,
+                f"{new_flags} pre-existing lint flag(s) (baseline had "
+                f"them too)"))
+    for name in sorted(set(base_sh) - set(new_sh)):
+        findings.append(Finding(
+            "sharding", name, "sharding-lint row present in baseline, "
+            "missing in new report", regression=True))
 
     return DiffResult(findings=findings, first_bad_stage=first_bad)

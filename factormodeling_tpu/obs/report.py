@@ -21,8 +21,14 @@ import time
 from contextlib import contextmanager
 from pathlib import Path
 
-__all__ = ["RunReport", "SpanHandle", "active_report", "record_stage",
-           "span", "cost_estimate"]
+__all__ = ["RunReport", "SCHEMA_VERSION", "SpanHandle", "active_report",
+           "record_stage", "span", "cost_estimate"]
+
+#: report row-schema version, carried by every report's ``kind="meta"``
+#: header row. Bump when row kinds/fields change incompatibly;
+#: ``tools/report_diff.py`` refuses to gate mismatched versions.
+#: 3 = PR 5: meta header + comms/memory/sharding placement-ledger rows.
+SCHEMA_VERSION = 3
 
 _ACTIVE: "RunReport | None" = None
 
@@ -73,10 +79,18 @@ class RunReport:
         rep.write_jsonl("run_report.jsonl")
     """
 
-    def __init__(self, label: str | None = None, meta: dict | None = None):
+    def __init__(self, label: str | None = None, meta: dict | None = None,
+                 *, comms: bool = False):
         self.label = label
         self.meta = dict(meta or {})
         self.rows: list[dict] = []
+        #: opt-in placement-ledger collection: with True, instrumented jit
+        #: entry points contribute comms/memory/sharding rows on every
+        #: compile (an extra AOT lowering+compile per entry point — see
+        #: add_placement). False (the default) is STRUCTURAL elision: no
+        #: HLO is ever rendered or walked, and the report's rows are
+        #: bit-identical to a build without the ledger feature.
+        self.comms = bool(comms)
 
     # ------------------------------------------------------------- recording
 
@@ -100,11 +114,18 @@ class RunReport:
         when outputs were registered — the fence is SKIPPED on that path,
         so the truncated window may have timed dispatch only and
         ``tools/trace_report.py``'s soundness column must not overclaim a
-        crashed stage as soundly timed.
+        crashed stage as soundly timed. Where the backend exposes
+        ``device.memory_stats()`` (TPU/GPU; not CPU — skipped with the
+        reason recorded by the memory rows), the exit path also samples
+        the live device-memory gauges into ``mem_bytes_in_use`` /
+        ``mem_peak_bytes``, so the span that blew the HBM watermark is
+        identifiable from the report.
         """
         import sys
 
         import jax
+
+        from factormodeling_tpu.obs import memory as _memory
 
         handle = SpanHandle()
         t0 = time.perf_counter()
@@ -117,9 +138,13 @@ class RunReport:
                     jax.block_until_ready(handle._outputs)
                 wall = time.perf_counter() - t0
                 err = {"error": True} if raised else {}
+                gauges = _memory.live_watermark()
+                mem = ({"mem_bytes_in_use": gauges["bytes_in_use"],
+                        "mem_peak_bytes": gauges["peak_bytes_in_use"]}
+                       if gauges is not None else {})
                 self.record(name, kind="span", wall_s=round(wall, 6),
                             fenced=bool(handle._outputs) and not raised,
-                            **{**fields, **handle.fields, **err})
+                            **{**fields, **handle.fields, **mem, **err})
 
     def add_counters(self, name: str, counters) -> None:
         """Summarize a :class:`~factormodeling_tpu.obs.counters.StageCounters`
@@ -188,6 +213,59 @@ class RunReport:
         except Exception as e:  # pragma: no cover - backend-dependent
             return self.record(name, kind="cost", error=str(e))
 
+    def add_placement(self, name: str, target, *args,
+                      declared_in_shardings=None, mesh=None, stages=None,
+                      **kwargs) -> "dict | None":
+        """The placement ledger of one compiled entry point: comms rows
+        (``kind="comms"``, per-stage collective counts + byte estimates
+        and a per-mesh-axis total), a ``kind="memory"`` footprint row,
+        and a ``kind="sharding"`` lint verdict against the declared
+        PartitionSpecs (:mod:`factormodeling_tpu.obs.comms` /
+        :mod:`~factormodeling_tpu.obs.memory`).
+
+        ``target`` may be a ``Lowered`` (best: its ``out_info`` enables
+        the output-side lint), a ``Compiled``, HLO text (comms only), or
+        a jit wrapper plus its call args — the latter pays one AOT
+        lowering+compile (cached by jax for repeat calls of the same
+        module). ``mesh`` defaults to the one recoverable from the
+        compiled shardings. Failures record a ``kind="comms"`` error row
+        rather than raising — ledger collection must never break the
+        entry point that triggered it. Returns the lint verdict (or the
+        error row)."""
+        from factormodeling_tpu.obs import comms as _comms
+        from factormodeling_tpu.obs import memory as _memory
+
+        try:
+            if isinstance(target, str):
+                lowered = compiled = None
+                text = target
+            else:
+                lowered, compiled = _comms.resolve(target, *args, **kwargs)
+                text = _comms.hlo_text_of(compiled)
+            if mesh is None and compiled is not None:
+                mesh = _comms.mesh_of(compiled)
+            ledger = _comms.comms_ledger(text, mesh=mesh,
+                                         **({"stages": stages}
+                                            if stages is not None else {}))
+            if ledger.mesh_shape:
+                self.meta.setdefault("mesh_shape", ledger.mesh_shape)
+            for row in ledger.rows(name):
+                self.rows.append(row)
+            if compiled is None:
+                return None
+            mem = _memory.memory_summary(compiled)
+            gauges = _memory.live_watermark()
+            if gauges is None:
+                gauges = ("skipped: "
+                          f"{_memory.watermark_unavailable_reason()}")
+            self.record(name, kind="memory", **mem, device_stats=gauges)
+            lint = _comms.sharding_lint(
+                compiled, declared_in_shardings=declared_in_shardings,
+                lowered=lowered, mesh=mesh)
+            return self.record(name, kind="sharding", **lint)
+        except Exception as e:
+            return self.record(name, kind="comms", error=str(e))
+
     # ------------------------------------------------------------ lifecycle
 
     @contextmanager
@@ -204,16 +282,43 @@ class RunReport:
 
     # -------------------------------------------------------------- output
 
+    def header(self) -> dict:
+        """The report's ``kind="meta"`` header row: row-schema version
+        plus the environment identity (jax version, backend/device kind,
+        device/process counts, mesh shape when a placement ledger noted
+        one). ``tools/report_diff.py`` refuses to gate reports whose
+        schema versions differ and downgrades wall gating to a warning
+        across backends — the meta row is what makes either judgment
+        possible from the artifact alone."""
+        import jax
+
+        dev = jax.devices()[0]
+        return {"kind": "meta", "name": "report",
+                "schema_version": SCHEMA_VERSION,
+                "jax_version": jax.__version__,
+                "backend": dev.platform,
+                "device_kind": dev.device_kind,
+                "device_count": jax.device_count(),
+                "process_count": jax.process_count(),
+                "mesh_shape": self.meta.get("mesh_shape")}
+
+    def all_rows(self) -> list:
+        """Header + recorded rows — what :meth:`write_jsonl` emits; use
+        this (not ``.rows``) when diffing an in-memory report against a
+        written baseline so the meta header participates."""
+        return [self.header()] + self.rows
+
     def to_dict(self) -> dict:
         return {"label": self.label, "meta": self.meta, "rows": self.rows}
 
     def write_jsonl(self, path) -> Path:
-        """One JSON object per row (label/meta folded into each, so rows are
-        self-contained for stream processing); returns the path."""
+        """One JSON object per row, ``kind="meta"`` header first
+        (label/meta folded into each row, so rows are self-contained for
+        stream processing); returns the path."""
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
         with path.open("w") as fh:
-            for row in self.rows:
+            for row in self.all_rows():
                 out = dict(row)
                 if self.label is not None:
                     out.setdefault("label", self.label)

@@ -72,22 +72,32 @@ def rolling_valid(x: jnp.ndarray, window: int, *, axis: int = _DATE_AXIS) -> jnp
 
 def shift(x: jnp.ndarray, periods: int = 1, *, axis: int = _DATE_AXIS,
           fill_value=jnp.nan) -> jnp.ndarray:
-    """pandas ``shift(periods)`` along ``axis`` (positive = toward later dates)."""
+    """pandas ``shift(periods)`` along ``axis`` (positive = toward later dates).
+
+    Implemented as roll + masked fill, NOT slice + concatenate-with-fill:
+    concatenating a replicated fill block onto an axis that is date-sharded
+    while another mesh axis replicates the operand miscompiles under GSPMD
+    on jax 0.4.x — the partitioner inserts a spurious all-reduce over the
+    replica axis and the shifted values come out multiplied by its size
+    (measured exactly x4 on the (4, 2) research mesh via
+    ``streamed_factor_stats(..., mesh=...)``, the same bug class
+    ``obs/counters.py`` documents for its churn delta). ``jnp.roll`` of a
+    sharded operand plus an iota-mask ``where`` partitions cleanly.
+    """
     if periods == 0:
         return x
     axis = axis % x.ndim
     d = x.shape[axis]
     k = abs(periods)
+    fill = jnp.full((), fill_value, dtype=x.dtype)
     if k >= d:
         return jnp.full_like(x, fill_value)
-    fill_shape = list(x.shape)
-    fill_shape[axis] = k
-    fill = jnp.full(fill_shape, fill_value, dtype=x.dtype)
-    if periods > 0:
-        kept = lax.slice_in_dim(x, 0, d - k, axis=axis)
-        return jnp.concatenate([fill, kept], axis=axis)
-    kept = lax.slice_in_dim(x, k, d, axis=axis)
-    return jnp.concatenate([kept, fill], axis=axis)
+    idx_shape = [1] * x.ndim
+    idx_shape[axis] = d
+    idx = jnp.arange(d).reshape(idx_shape)
+    rolled = jnp.roll(x, periods, axis=axis)
+    mask = idx < k if periods > 0 else idx >= d - k
+    return jnp.where(mask, fill, rolled)
 
 
 def compaction_order(present: jnp.ndarray, *, axis: int = _DATE_AXIS):

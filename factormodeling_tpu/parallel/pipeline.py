@@ -260,6 +260,11 @@ def make_sharded_research_step(mesh: Mesh, *, names, window: int,
             blend_method, tuple(sorted((sim_kwargs or {}).items())),
             tuple(mesh.shape.items()), factor_axis, date_axis,
             collect_counters, collect_probes))
+    # declared placement intent, threaded to the placement ledger
+    # (obs.comms.sharding_lint / RunReport.add_placement): the lint
+    # compares the COMPILED step's actual shardings against exactly these
+    jitted.declared_in_shardings = in_shardings
+    jitted.mesh = mesh
 
     d_size = mesh.shape[date_axis]
 

@@ -33,6 +33,7 @@ from factormodeling_tpu.backtest.pnl import daily_portfolio_returns
 from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.multimanager import compute_manager_weights
 from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.compile_log import entry_point_tag, instrument_jit
 from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.parallel.pipeline import result_summary
 
@@ -140,4 +141,11 @@ def make_sharded_manager_sweep(mesh: Mesh, *, combo_axis: str = "combo",
         books = jax.lax.with_sharding_constraint(books, factor_sharded)
         return sharded(books, combo_weights, settings)
 
-    return sweep
+    # compile telemetry + placement-ledger participation, like the
+    # sharded research step: each compile lands as a kind="compile" row
+    # and (report comms=True) contributes the sweep's collective ledger
+    wrapped = instrument_jit(
+        sweep, "parallel/manager_sweep/" + entry_point_tag(
+            tuple(mesh.shape.items()), combo_axis, combo_batch))
+    wrapped.mesh = mesh
+    return wrapped

@@ -1,0 +1,431 @@
+"""The placement ledger: comms accounting, memory telemetry, sharding lint.
+
+Four load-bearing guarantees:
+
+- the HLO parse + byte model are exact on synthetic collectives (both
+  replica-group syntaxes, tuple operands, mesh-axis attribution);
+- on the 8-virtual-device mesh the REAL sharded research step's ledger
+  contains cross-``date``-axis reductions for the IC/selection stage and
+  the lint is clean for the canonical ``panel_sharding``/``stack_sharding``
+  specs — while a deliberately-replicated variant is flagged AND gated
+  (``tools/report_diff.py`` exits 1 on the new collectives + byte growth
+  + lint flag);
+- ledger-off is structural: a report built without ``comms=True`` never
+  renders or walks HLO (counting stub on the single accessor);
+- memory telemetry degrades gracefully (``cost_analysis`` fallback,
+  skip-with-reason watermarks on CPU).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu import obs
+from factormodeling_tpu.obs import comms as obs_comms
+from factormodeling_tpu.obs import memory as obs_memory
+from factormodeling_tpu.obs.regression import diff_reports
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO / "tools") not in sys.path:  # for `import trace_report`
+    sys.path.insert(0, str(REPO / "tools"))
+
+NAMES = ("mom_eq", "mom_flx", "val_long", "val_short",
+         "qual_eq", "qual_flx", "size_long", "size_short")
+F, D, N, WINDOW = len(NAMES), 32, 16, 6
+
+
+# --------------------------------------------------------- parse + model
+
+
+SYNTH_HLO = """
+HloModule jit_step
+
+ENTRY %main {
+  %all-gather = f32[2,64,24]{2,0,1} all-gather(f32[2,32,24]{2,0,1} %c), channel_id=21, replica_groups={{0,1},{2,3},{4,5},{6,7}}, dimensions={1}, use_global_device_ids=true, metadata={op_name="jit(step)/jit(main)/selection/rolling/gather" source_file="x.py"}
+  %all-reduce.2 = f32[32]{0} all-reduce(f32[32]{0} %r), channel_id=49, replica_groups=[2,4]<=[4,2]T(1,0), use_global_device_ids=true, to_apply=%add, metadata={op_name="jit(step)/jit(main)/composite/blend/reduce_sum"}
+  %collective-permute.1 = f32[2,1,24]{2,0,1} collective-permute(f32[2,1,24]{2,0,1} %s), channel_id=22, source_target_pairs={{0,1},{2,3},{4,5},{6,7}}, metadata={op_name="jit(step)/jit(main)/selection/rolling/slice"}
+  %tuple-ar = (f32[2,8]{1,0}, f32[2,8]{1,0}) all-reduce(f32[2,8]{1,0} %a, f32[2,8]{1,0} %b), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add2, metadata={op_name="jit(step)/jit(main)/anon/thing"}
+  %all-gather-done.1 = f32[4]{0} all-gather-done(f32[4]{0} %ags)
+}
+"""
+
+
+def test_parse_collectives_byte_model_and_axis_attribution():
+    mesh = {"factor": 4, "date": 2}
+    ops = obs_comms.parse_collectives(SYNTH_HLO, mesh=mesh)
+    assert [op.kind for op in ops] == ["all-gather", "all-reduce",
+                                      "collective-permute", "all-reduce"]
+    ag, ar, cp, tar = ops
+
+    # all-gather: 4 groups of 2 over the fast (date) axis; operand is the
+    # local shard (2*32*24 f32 = 6144 B), per-device (S-1)*shard, mesh
+    # total x 8 participants
+    assert (ag.stage, ag.axis, ag.group_size, ag.n_groups) == \
+        ("selection/rolling", "date", 2, 4)
+    assert ag.operand_bytes == 2 * 32 * 24 * 4
+    assert ag.bytes_moved == (2 - 1) * ag.operand_bytes * 8
+
+    # iota groups [2,4]<=[4,2]T(1,0) materialize to {0,2,4,6},{1,3,5,7}:
+    # the factor axis of a row-major (4,2) mesh; ring all-reduce moves
+    # 2(S-1)/S x buffer per device
+    assert (ar.stage, ar.axis, ar.group_size, ar.n_groups) == \
+        ("composite/blend", "factor", 4, 2)
+    assert ar.operand_bytes == 32 * 4
+    assert ar.bytes_moved == pytest.approx(2 * 3 / 4 * 128 * 8)
+
+    # permute: one buffer per source->target pair, pairs span date
+    assert (cp.stage, cp.axis) == ("selection/rolling", "date")
+    assert cp.bytes_moved == 2 * 1 * 24 * 4 * 4
+
+    # tuple all-reduce sums BOTH operands; full-mesh group names both axes;
+    # unknown scope lands in the honest bucket (XLA hoists some ops out of
+    # any named scope)
+    assert tar.stage == "unattributed"
+    assert tar.axis == "factor+date"
+    assert tar.operand_bytes == 2 * (2 * 8 * 4)
+    # async -done halves are never double-counted
+    assert not any("done" in op.op_name for op in ops)
+
+    ledger = obs_comms.CommsLedger(ops, mesh_shape=mesh)
+    by_stage = ledger.by_stage()
+    assert by_stage["selection/rolling"]["collectives"]["all-gather"][
+        "count"] == 1
+    totals = ledger.totals()
+    assert totals["collectives"] == 4
+    assert totals["bytes_moved"] == pytest.approx(
+        sum(op.bytes_moved for op in ops))
+    assert set(totals["by_axis"]) == {"date", "factor", "factor+date"}
+    rows = ledger.rows("step")
+    assert rows[-1]["stage"] == "total" and rows[-1]["mesh_shape"] == mesh
+
+
+def test_stage_attribution_prefers_longest_scope_at_a_tie():
+    """A scope that extends another (``selection/rolling_metrics`` vs its
+    prefix ``selection/rolling``) must win attribution when it is the one
+    actually present — the prefix ties on position and must not shadow
+    it."""
+    line = ('  %all-reduce.9 = f32[8]{0} all-reduce(f32[8]{0} %r), '
+            'replica_groups={{0,1}}, to_apply=%add, metadata={op_name='
+            '"jit(step)/jit(main)/selection/rolling_metrics/reduce_sum"}')
+    (op,) = obs_comms.parse_collectives(line)
+    assert op.stage == "selection/rolling_metrics"
+
+
+def test_hlo_text_passthrough_and_resolve_errors():
+    led = obs_comms.comms_ledger(SYNTH_HLO, mesh={"factor": 4, "date": 2})
+    assert led.totals()["collectives"] == 4
+    with pytest.raises(TypeError, match="cannot resolve"):
+        obs_comms.resolve(object())
+
+
+# ------------------------------------------------- the real sharded step
+
+
+def _make_raw(rng):
+    factors = rng.normal(size=(F, D, N)).astype(np.float32)
+    returns = rng.normal(scale=0.02, size=(D, N)).astype(np.float32)
+    factor_ret = rng.normal(scale=0.01, size=(D, F)).astype(np.float32)
+    cap = rng.integers(1, 4, size=(D, N)).astype(np.float32)
+    inv = np.ones((D, N), np.float32)
+    uni = np.ones((D, N), dtype=bool)
+    return factors, returns, factor_ret, cap, inv, uni
+
+
+@pytest.fixture(scope="module")
+def sharded_artifacts():
+    """(mesh, step, lowered, compiled, args) for the canonical sharded
+    research step — compiled once for the whole module."""
+    from factormodeling_tpu.parallel import make_sharded_research_step
+    from factormodeling_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device conftest mesh")
+    mesh = make_mesh(("factor", "date"))
+    step, shard_inputs = make_sharded_research_step(
+        mesh, names=NAMES, window=WINDOW,
+        sim_kwargs=dict(method="equal", pct=0.3))
+    args = shard_inputs(*_make_raw(np.random.default_rng(3)))
+    lowered = step.lower(*args)
+    return mesh, step, lowered, lowered.compile(), args
+
+
+def test_sharded_step_ledger_pins_ic_stage_collectives(sharded_artifacts):
+    """The IC/selection stage genuinely communicates across the mesh, and
+    the ledger attributes it: >= 1 cross-``date``-axis collective in
+    ``selection/rolling`` (the rolling windows' halo exchanges across the
+    date shards — permutes/gathers, NOT all-reduces: the §16 shift fix
+    replaced the miscompiling concat whose artifact was a spurious
+    date-axis all-reduce) plus >= 1 ``factor``-axis all-reduce where the
+    selection/blend layers contract the factor axis; the summary
+    reductions all-reduce over ``date``. Every mesh axis must carry
+    traffic — a zero-byte axis would mean the partitioner stopped
+    sharding it."""
+    mesh, step, lowered, compiled, args = sharded_artifacts
+    ledger = obs_comms.comms_ledger(compiled, mesh=mesh)
+    ic_halo = [op for op in ledger.ops
+               if op.stage == "selection/rolling" and op.axis == "date"]
+    assert len(ic_halo) >= 1
+    assert all(op.bytes_moved > 0 for op in ic_halo)
+    factor_reductions = [op for op in ledger.ops
+                         if op.kind == "all-reduce" and op.axis == "factor"
+                         and op.stage in ("selection/rolling",
+                                          "composite/blend")]
+    assert len(factor_reductions) >= 1
+    date_reductions = [op for op in ledger.ops
+                       if op.kind == "all-reduce" and op.axis == "date"]
+    assert len(date_reductions) >= 1  # pipeline summary over date shards
+    totals = ledger.totals()
+    assert totals["by_axis"].get("date", 0) > 0
+    assert totals["by_axis"].get("factor", 0) > 0
+    # mesh recovery from the compiled shardings matches the explicit one
+    auto = obs_comms.comms_ledger(compiled)
+    assert auto.totals()["by_axis"] == totals["by_axis"]
+
+
+def test_sharding_lint_clean_for_canonical_specs(sharded_artifacts):
+    mesh, step, lowered, compiled, args = sharded_artifacts
+    verdict = obs_comms.sharding_lint(
+        compiled, declared_in_shardings=step.declared_in_shardings,
+        lowered=lowered, mesh=mesh)
+    assert verdict["clean"], verdict["flags"]
+    assert verdict["checked_inputs"] >= 5
+    assert verdict["checked_outputs"] >= 3  # selection/signal/weights...
+    assert verdict["n_devices"] == 8
+
+
+@pytest.fixture(scope="module")
+def replicated_artifacts(sharded_artifacts):
+    """A deliberately-degraded variant: the selection and signal
+    intermediates are constrained to FULL REPLICATION, which forces XLA
+    to all-gather them (new collectives + byte growth) and replicates
+    two >= 2-D outputs (lint flags)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from factormodeling_tpu.parallel.pipeline import build_research_step
+
+    mesh, step, _, _, args = sharded_artifacts
+    rep = NamedSharding(mesh, PartitionSpec())
+    base = build_research_step(names=NAMES, window=WINDOW,
+                               sim_kwargs=dict(method="equal", pct=0.3))
+
+    def bad_step(*a):
+        out = base(*a)
+        return out._replace(
+            selection=jax.lax.with_sharding_constraint(out.selection, rep),
+            signal=jax.lax.with_sharding_constraint(out.signal, rep))
+
+    lowered = jax.jit(
+        bad_step, in_shardings=step.declared_in_shardings).lower(*args)
+    return mesh, lowered, lowered.compile()
+
+
+def test_replicated_variant_flags_lint_and_grows_comms(
+        sharded_artifacts, replicated_artifacts):
+    mesh, step, good_lowered, good_compiled, args = sharded_artifacts
+    _, bad_lowered, bad_compiled = replicated_artifacts
+
+    verdict = obs_comms.sharding_lint(
+        bad_compiled, declared_in_shardings=step.declared_in_shardings,
+        lowered=bad_lowered, mesh=mesh)
+    assert not verdict["clean"]
+    assert any("REPLICATED" in f and ".selection" in f
+               for f in verdict["flags"])
+    assert any(".signal" in f for f in verdict["flags"])
+
+    good = obs_comms.comms_ledger(good_compiled, mesh=mesh).totals()
+    bad = obs_comms.comms_ledger(bad_compiled, mesh=mesh).totals()
+    # replicating the intermediates costs all-gathers the clean step
+    # never pays: strictly more collectives and more estimated bytes
+    assert bad["by_kind"]["all-gather"]["count"] > \
+        good["by_kind"].get("all-gather", {}).get("count", 0)
+    assert bad["bytes_moved"] > good["bytes_moved"]
+
+
+def test_report_diff_cli_gates_replicated_variant(
+        sharded_artifacts, replicated_artifacts, tmp_path):
+    """The acceptance loop end to end: a clean placement report vs one
+    with the injected replicated-operand sharding — ``report_diff``
+    exits 1 and attributes the new collectives, the byte growth, and the
+    lint flag; ``trace_report --strict`` also fails on the lint flag."""
+    mesh, step, good_lowered, good_compiled, args = sharded_artifacts
+    _, bad_lowered, bad_compiled = replicated_artifacts
+
+    def write(label, lowered, path):
+        rep = obs.RunReport(label)
+        rep.add_placement("parallel/research_step", lowered,
+                          declared_in_shardings=step.declared_in_shardings,
+                          mesh=mesh)
+        return rep.write_jsonl(path)
+
+    clean_path = write("clean", good_lowered, tmp_path / "clean.jsonl")
+    bad_path = write("replicated", bad_lowered, tmp_path / "bad.jsonl")
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "report_diff.py"),
+         str(clean_path), str(bad_path), "--no-wall", "--json"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout)
+    regs = "\n".join(verdict["regressions"])
+    assert "all-gather" in regs          # new collectives, attributed
+    assert "[sharding]" in regs          # lint flag gated
+    # the clean pair still gates green
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "report_diff.py"),
+         str(clean_path), str(clean_path), "--no-wall"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # trace_report: renders the three new sections; --strict exits 1 on
+    # the lint flag (and 0 on the clean report)
+    import trace_report
+
+    rows = trace_report.load_rows([bad_path])
+    rendered = trace_report.render(rows)
+    for section in ("== comms ledger", "== device memory",
+                    "== sharding lint"):
+        assert section in rendered
+    assert trace_report.main([str(clean_path), "--strict"]) == 0
+    assert trace_report.main([str(bad_path), "--strict"]) == 1
+    assert trace_report.lint_flagged(rows) == ["parallel/research_step"]
+
+
+def test_in_memory_diff_matches_cli_semantics(
+        sharded_artifacts, replicated_artifacts):
+    mesh, step, good_lowered, good_compiled, args = sharded_artifacts
+    _, bad_lowered, bad_compiled = replicated_artifacts
+    good_rep, bad_rep = obs.RunReport("g"), obs.RunReport("b")
+    good_rep.add_placement("step", good_compiled, mesh=mesh)
+    bad_rep.add_placement("step", bad_compiled, mesh=mesh)
+    res = diff_reports(good_rep.all_rows(), bad_rep.all_rows(),
+                       check_wall=False)
+    assert not res.ok
+    kinds = {f.kind for f in res.regressions}
+    assert "comms" in kinds
+
+
+# ----------------------------------------------------- structural elision
+
+
+def test_ledger_off_never_walks_hlo(monkeypatch):
+    """The elision contract: with ``comms=False`` (the default) a
+    compiled instrumented entry point contributes its compile row and
+    NOTHING touches HLO — no ``as_text``, no parse (counting stub on the
+    single accessor every ledger path routes through). With
+    ``comms=True`` the same entry point contributes the full ledger."""
+    calls = {"n": 0}
+    real = obs_comms.hlo_text_of
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(obs_comms, "hlo_text_of", counting)
+
+    rep_off = obs.RunReport("ledger-off")
+    with rep_off.activate():
+        f = obs.instrument_jit(jax.jit(lambda x: x * 2.0), "unit/led_off")
+        f(jnp.ones((5,)))
+        f(jnp.ones((5,)))  # steady-state call: no compile, no ledger
+    assert calls["n"] == 0
+    assert [r["kind"] for r in rep_off.rows] == ["compile"]
+
+    rep_on = obs.RunReport("ledger-on", comms=True)
+    with rep_on.activate():
+        g = obs.instrument_jit(jax.jit(lambda x: x * 3.0), "unit/led_on")
+        g(jnp.ones((5,)))
+    assert calls["n"] >= 1
+    kinds = {r["kind"] for r in rep_on.rows}
+    assert {"compile", "comms", "memory", "sharding"} <= kinds
+    # single-device entry point: zero collectives, lint trivially clean
+    total = next(r for r in rep_on.rows if r["kind"] == "comms"
+                 and r["stage"] == "total")
+    assert total["bytes_moved"] == 0
+    lint = next(r for r in rep_on.rows if r["kind"] == "sharding")
+    assert lint["clean"]
+
+
+def test_add_placement_failure_records_error_row_not_raise():
+    rep = obs.RunReport("err")
+    row = rep.add_placement("broken", object())
+    assert row["kind"] == "comms" and "error" in row
+    # error rows are excluded from gating
+    assert diff_reports(rep.all_rows(), rep.all_rows(),
+                        check_wall=False).ok
+
+
+# ------------------------------------------------------- memory telemetry
+
+
+def test_memory_summary_and_watermark_skip_reason(sharded_artifacts):
+    _, _, _, compiled, _ = sharded_artifacts
+    mem = obs_memory.memory_summary(compiled)
+    assert mem["source"] == "memory_analysis"
+    assert mem["argument_bytes"] > 0 and mem["temp_bytes"] > 0
+    assert mem["peak_bytes"] == (mem["argument_bytes"] + mem["output_bytes"]
+                                 + mem["temp_bytes"] - mem["alias_bytes"])
+    assert obs_memory.peak_bytes(compiled) == mem["peak_bytes"]
+
+    # fallback ladder: no memory_analysis -> cost_analysis bytes;
+    # neither -> reason, never a raise
+    class CostOnly:
+        def memory_analysis(self):
+            return None
+
+        def cost_analysis(self):
+            return [{"bytes accessed": 123.0}]
+
+    fb = obs_memory.memory_summary(CostOnly())
+    assert fb["source"] == "cost_analysis" and fb["bytes_accessed"] == 123.0
+
+    class Nothing:
+        def memory_analysis(self):
+            raise RuntimeError("unsupported")
+
+        def cost_analysis(self):
+            raise RuntimeError("also unsupported")
+
+    nb = obs_memory.memory_summary(Nothing())
+    assert nb["source"] is None and "unsupported" in nb["reason"]
+
+    # CPU backend: watermarks skip with a cached reason, spans stay bare
+    assert obs_memory.live_watermark() is None
+    assert "memory_stats" in obs_memory.watermark_unavailable_reason()
+    rep = obs.RunReport("span")
+    with rep.span("s") as sp:
+        sp.add(jnp.ones((4,)))
+    assert "mem_peak_bytes" not in rep.rows[-1]
+
+
+# ------------------------------------------------------------ meta header
+
+
+def test_report_meta_header_and_write_order(tmp_path):
+    rep = obs.RunReport("hdr", meta={"mesh_shape": {"factor": 4, "date": 2}})
+    rep.record("x", kind="stage", v=1)
+    head = rep.header()
+    assert head["kind"] == "meta"
+    assert head["schema_version"] == obs.SCHEMA_VERSION
+    assert head["backend"] == "cpu" and head["device_count"] == 8
+    assert head["mesh_shape"] == {"factor": 4, "date": 2}
+    assert rep.all_rows()[0] == head
+
+    path = rep.write_jsonl(tmp_path / "r.jsonl")
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert lines[0]["kind"] == "meta"
+    assert lines[0]["label"] == "hdr"  # label folded into the header too
+
+    import trace_report
+
+    rendered = trace_report.render(lines)
+    assert f"schema_version={obs.SCHEMA_VERSION}" in rendered
+    # the meta row must NOT leak into the stage-records table
+    assert "== stage records ==" in rendered
+    stage_section = rendered.split("== stage records ==")[1]
+    assert "schema_version" not in stage_section

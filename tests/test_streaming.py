@@ -361,3 +361,36 @@ def test_streamed_fused_device_source_on_mesh(rng):
             got["factor_return"].sharding
     finally:
         clear_streaming_cache()  # the fused kernel pins the sharded stack
+
+
+def test_streamed_f32_factor_return_on_2d_mesh_matches_dense(rng):
+    """Regression pin for the GSPMD shift miscompile (PR 5): on a 2-D
+    ``("factor", "date")`` mesh the streamed chunks REPLICATE over the
+    factor axis, and the old slice+concat ``shift`` made the partitioner
+    insert a spurious all-reduce over that axis — the shifted f32 factor
+    came out exactly x4 (= the factor-axis size) and ``factor_return``
+    x1/4. Scale-INVARIANT stats (rank_ic/ic) cancel the blowup, and f64
+    partitions differently, which is why only this f32 + factor_return
+    combination catches it. ``ops/_window.py::shift`` is now roll+mask;
+    this must stay exact (the shift is a pure data movement)."""
+    from factormodeling_tpu.parallel import make_mesh
+    from factormodeling_tpu.parallel.streaming import clear_streaming_cache
+
+    f, d, n, chunk = 8, 32, 16, 4
+    stack = rng.normal(size=(f, d, n)).astype(np.float32)
+    rets = rng.normal(scale=0.02, size=(d, n)).astype(np.float32)
+    mesh = make_mesh(("factor", "date"))
+    assert mesh.shape["factor"] > 1, "needs a >1 factor axis to replicate"
+    source, slices = host_array_source(stack, chunk)
+    try:
+        sharded = streamed_factor_stats(
+            source, len(slices), jnp.asarray(rets),
+            stats=("factor_return",), mesh=mesh)
+        dense = daily_factor_stats(jnp.asarray(stack), jnp.asarray(rets),
+                                   shift_periods=1,
+                                   stats=("factor_return",))
+        np.testing.assert_allclose(np.asarray(sharded["factor_return"]),
+                                   np.asarray(dense["factor_return"]),
+                                   atol=1e-6, equal_nan=True)
+    finally:
+        clear_streaming_cache()

@@ -4,15 +4,20 @@ regression.
 Usage::
 
     python tools/report_diff.py baseline.jsonl new.jsonl [--wall-ratio 1.5]
-        [--wall-min-s 0.05] [--no-wall] [--finite-tol 1e-6] [--json]
+        [--wall-min-s 0.05] [--no-wall] [--finite-tol 1e-6]
+        [--comms-ratio 1.5] [--mem-ratio 1.5] [--json]
 
 The CI loop this enables: run with ``--report`` (``examples/pipeline.py``,
 ``bench.py``, or your own ``RunReport``), keep a known-good report as the
 baseline (``tests/goldens/obs_report_clean.jsonl`` is the committed
 example), and gate merges on this diff — a span that got 1.5x slower, a
 solver-fallback counter that ticked up, a probe stage whose finite
-fraction dropped (the watchdog names the first bad stage), or a silent jit
-retrace all exit 1 with a one-line attribution.
+fraction dropped (the watchdog names the first bad stage), a silent jit
+retrace, a new collective / comms-byte blowup in the placement ledger, a
+peak-device-memory jump, or a sharding-lint flag (replicated/resharded
+operand) all exit 1 with a one-line attribution. Reports with mismatched
+``kind="meta"`` schema versions REFUSE to gate; cross-backend pairs warn
+and skip wall gating automatically.
 
 Pure stdlib, no jax: the diff logic lives in
 ``factormodeling_tpu/obs/regression.py`` (itself stdlib-only) and is
@@ -71,6 +76,19 @@ def main(argv=None) -> int:
     parser.add_argument("--finite-tol", type=float, default=1e-6,
                         help="tolerated finite-fraction drop per probe "
                              "stage (default 1e-6)")
+    parser.add_argument("--comms-ratio", type=float, default=1.5,
+                        help="max new/baseline estimated comms bytes per "
+                             "ledger row (default 1.5; collective COUNT "
+                             "increases always gate)")
+    parser.add_argument("--comms-min-bytes", type=float, default=1024.0,
+                        help="absolute comms-byte growth below this never "
+                             "gates (default 1 KiB)")
+    parser.add_argument("--mem-ratio", type=float, default=1.5,
+                        help="max new/baseline peak device bytes per "
+                             "entry point (default 1.5)")
+    parser.add_argument("--mem-min-bytes", type=float, default=float(1 << 20),
+                        help="absolute peak-byte growth below this never "
+                             "gates (default 1 MiB)")
     parser.add_argument("--json", action="store_true",
                         help="emit the findings as one JSON object instead "
                              "of text")
@@ -81,7 +99,9 @@ def main(argv=None) -> int:
         reg.load_jsonl(args.baseline), reg.load_jsonl(args.new),
         wall_ratio=args.wall_ratio, wall_min_s=args.wall_min_s,
         check_wall=not args.no_wall, counter_tol=args.counter_tol,
-        finite_tol=args.finite_tol)
+        finite_tol=args.finite_tol, comms_ratio=args.comms_ratio,
+        comms_min_bytes=args.comms_min_bytes, mem_ratio=args.mem_ratio,
+        mem_min_bytes=args.mem_min_bytes)
 
     if args.json:
         print(json.dumps({

@@ -5,9 +5,10 @@ Usage::
     python tools/trace_report.py run_report.jsonl [more.jsonl ...]
 
 Spans aggregate by name (count / total / mean / max wall seconds, whether
-they fenced); counters, cost-analysis estimates, bench rows, and plain
-stage records print in their own sections. Pure stdlib — usable on any box
-that has the JSONL, no jax required.
+they fenced); counters, numerics probes, compile telemetry, the placement
+ledger (comms / device memory / sharding lint), cost-analysis estimates,
+bench rows, and plain stage records print in their own sections. Pure
+stdlib — usable on any box that has the JSONL, no jax required.
 """
 
 from __future__ import annotations
@@ -200,10 +201,72 @@ def _compile_table(rows) -> str | None:
                           "signatures", "retraced"), body))
 
 
+def _comms_table(rows) -> str | None:
+    comms = [r for r in rows if r.get("kind") == "comms"]
+    if not comms:
+        return None
+    body = []
+    for r in comms:
+        if "error" in r:
+            body.append((r.get("name", "?"), "-", "-", "-",
+                         f"error: {r['error'][:50]}"))
+            continue
+        kinds = " ".join(
+            f"{k}x{v.get('count', 0)}"
+            for k, v in sorted((r.get("collectives") or {}).items()))
+        axis = " ".join(f"{a}={_num(float(b))}" for a, b in
+                        sorted((r.get("by_axis") or {}).items()))
+        body.append((r.get("name", "?"), r.get("stage", "?"),
+                     f"{float(r.get('bytes_moved', 0.0)):.4g}",
+                     kinds or "-", axis or "-"))
+    return ("== comms ledger (collectives in the compiled HLO; bytes are "
+            "the documented ring/butterfly estimates) ==\n"
+            + _fmt_table(("entry_point", "stage", "bytes_moved",
+                          "collectives", "by_axis"), body))
+
+
+def _memory_table(rows) -> str | None:
+    mem = [r for r in rows if r.get("kind") == "memory"]
+    if not mem:
+        return None
+    def b(r, key):
+        v = r.get(key)
+        return f"{float(v):.4g}" if isinstance(v, (int, float)) else "-"
+    body = [(r.get("name", "?"), r.get("source") or "-",
+             b(r, "argument_bytes"), b(r, "output_bytes"),
+             b(r, "temp_bytes"), b(r, "peak_bytes"),
+             str(r.get("device_stats", "-"))[:48])
+            for r in mem]
+    return ("== device memory (compiled footprint; device_stats = live "
+            "watermark or the skip reason) ==\n"
+            + _fmt_table(("entry_point", "source", "args_b", "out_b",
+                          "temp_b", "peak_b", "device_stats"), body))
+
+
+def _sharding_table(rows) -> str | None:
+    lint = [r for r in rows if r.get("kind") == "sharding"]
+    if not lint:
+        return None
+    body = []
+    for r in lint:
+        flags = r.get("flags") or []
+        body.append((r.get("name", "?"),
+                     "yes" if r.get("clean") else "NO",
+                     r.get("checked_inputs", "-"),
+                     r.get("checked_outputs", "-"),
+                     "; ".join(flags)[:90] or "-"))
+    return ("== sharding lint (declared PartitionSpecs vs the compiled "
+            "placement; clean NO = replication/resharding) ==\n"
+            + _fmt_table(("entry_point", "clean", "ins", "outs", "flags"),
+                         body))
+
+
 def _stage_table(rows) -> str | None:
     stages = [r for r in rows
               if r.get("kind") not in ("span", "counters", "cost", "bench",
-                                       "numerics", "watchdog", "compile")]
+                                       "numerics", "watchdog", "compile",
+                                       "comms", "memory", "sharding",
+                                       "meta")]
     if not stages:
         return None
     body = []
@@ -224,7 +287,7 @@ def _bench_table(rows) -> str | None:
     # serial comparison) renders inline so the regime is readable from the
     # table alone
     extra_keys = ("vs_serial_scan", "sweeps", "converged_day_frac",
-                  "suffix_len")
+                  "suffix_len", "comms_bytes", "peak_mem_bytes")
     body = [(r.get("name", "?"), r.get("value", "-"), r.get("unit", "s"),
              r.get("vs_baseline", "-"),
              " ".join(f"{k}={_num(r[k])}" for k in extra_keys if k in r)
@@ -240,9 +303,16 @@ def render(rows) -> str:
     labels = sorted({str(r.get("label")) for r in rows if r.get("label")})
     head = f"run report: {len(rows)} row(s)" + (
         f", label(s): {', '.join(labels)}" if labels else "")
+    meta = next((r for r in rows if r.get("kind") == "meta"), None)
+    if meta:
+        head += ("\nenv: " + " ".join(
+            f"{k}={meta.get(k)}" for k in
+            ("schema_version", "jax_version", "backend", "device_kind",
+             "device_count", "mesh_shape") if meta.get(k) is not None))
     sections = [head]
     for maker in (_span_table, _counter_table, _numerics_table,
-                  _watchdog_table, _compile_table, _cost_table,
+                  _watchdog_table, _compile_table, _comms_table,
+                  _memory_table, _sharding_table, _cost_table,
                   _bench_table, _stage_table):
         section = maker(rows)
         if section:
@@ -254,13 +324,22 @@ def unsound_spans(rows) -> list[str]:
     """Span names whose soundness mark is "NO": at least one row neither
     fenced device outputs nor declared ``sync: "host"`` — its window may
     have timed async dispatch only (error rows count too: their fence was
-    skipped). The ``--strict`` gate."""
+    skipped). Half of the ``--strict`` gate."""
     bad = set()
     for r in rows:
         if (r.get("kind") == "span" and not r.get("fenced")
                 and r.get("sync") != "host"):
             bad.add(r["name"])
     return sorted(bad)
+
+
+def lint_flagged(rows) -> list[str]:
+    """Entry points whose sharding-lint row is not clean — the placement
+    half of the ``--strict`` gate (a replicated/resharded operand in the
+    report should fail CI the same way an unsound span does)."""
+    return sorted({r.get("name", "?") for r in rows
+                   if r.get("kind") == "sharding"
+                   and not r.get("clean", True)})
 
 
 def main(argv=None) -> int:
@@ -270,17 +349,25 @@ def main(argv=None) -> int:
     parser.add_argument("--strict", action="store_true",
                         help="exit nonzero when any span row is unsound "
                              "(fenced NO: neither a device fence nor a "
-                             "declared host-synchronous window) — makes "
-                             "the renderer CI-able")
+                             "declared host-synchronous window) or any "
+                             "sharding-lint row is flagged — makes the "
+                             "renderer CI-able")
     args = parser.parse_args(argv)
     rows = load_rows(args.jsonl)
     print(render(rows))
     if args.strict:
+        rc = 0
         bad = unsound_spans(rows)
         if bad:
             print(f"strict: {len(bad)} span(s) with fenced == 'NO': "
                   + ", ".join(bad), file=sys.stderr)
-            return 1
+            rc = 1
+        flagged = lint_flagged(rows)
+        if flagged:
+            print(f"strict: {len(flagged)} entry point(s) with sharding-"
+                  f"lint flags: " + ", ".join(flagged), file=sys.stderr)
+            rc = 1
+        return rc
     return 0
 
 
