@@ -3,6 +3,7 @@
 from factormodeling_tpu.backtest.diagnostics import (  # noqa: F401
     SchemeStats,
     SolverDiagnostics,
+    anderson_stats,
     check_anomalies,
     polish_stats,
     sweep_stats,

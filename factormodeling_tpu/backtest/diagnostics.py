@@ -18,8 +18,8 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["SchemeStats", "SolverDiagnostics", "check_anomalies",
-           "polish_stats", "sweep_stats"]
+__all__ = ["SchemeStats", "SolverDiagnostics", "anderson_stats",
+           "check_anomalies", "polish_stats", "sweep_stats"]
 
 
 class SchemeStats(NamedTuple):
@@ -58,6 +58,20 @@ def sweep_stats(diag: "SolverDiagnostics") -> dict:
     }
 
 
+def anderson_stats(diag: "SolverDiagnostics") -> dict:
+    """Host-side JSON-ready summary of the Anderson-acceleration telemetry:
+    total extrapolation steps taken vs safeguard resets across the run, and
+    the acceptance share (NaN when the accelerator never engaged)."""
+    acc = int(np.asarray(diag.anderson_accepted).sum())
+    rej = int(np.asarray(diag.anderson_rejected).sum())
+    return {
+        "anderson_accepted": acc,
+        "anderson_rejected": rej,
+        "anderson_accept_rate": (acc / (acc + rej) if acc + rej
+                                 else float("nan")),
+    }
+
+
 class SolverDiagnostics(NamedTuple):
     """Per-date solver and invariant telemetry (all ``[D]``).
 
@@ -81,6 +95,17 @@ class SolverDiagnostics(NamedTuple):
       :class:`SchemeStats` fields restated per run (defaults 0 for schemes
       that run no solver — equal/linear — and for host-built pytrees);
       ``sweep_stats`` summarizes them for reports.
+    anderson_accepted / anderson_rejected: per-day (``[D]``) Anderson-
+      acceleration tallies — extrapolation steps taken vs safeguard resets
+      in that day's ADMM solve (0 everywhere with ``qp_anderson=0`` and for
+      the deterministic schemes). A high reject share means the safeguard
+      is doing the work and the acceleration budget should be re-examined.
+    iters_to_converge: per-day (``[D]``) first ADMM iteration at which the
+      combined residual reached the polish-identification grade
+      (``solvers/admm_qp.py::_CONV_TOL``), 0 when the budget ran out first
+      — collected only under the numerics-probes gate (the production step
+      carries constant zeros), and the basis of the
+      ``admm_iters_to_converge_p50_p99`` bench row.
     """
 
     primal_residual: jnp.ndarray
@@ -95,6 +120,9 @@ class SolverDiagnostics(NamedTuple):
     sweeps: jnp.ndarray | int = 0
     converged_days: jnp.ndarray | int = 0
     suffix_len: jnp.ndarray | int = 0
+    anderson_accepted: jnp.ndarray | int = 0
+    anderson_rejected: jnp.ndarray | int = 0
+    iters_to_converge: jnp.ndarray | int = 0
 
 
 def polish_stats(diag: SolverDiagnostics) -> dict:

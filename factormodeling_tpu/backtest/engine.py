@@ -49,7 +49,8 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
     d = signal.shape[0]
     nan_d = jnp.full((d,), jnp.nan, signal.dtype)
     ok_d = jnp.ones((d,), bool)
-    no_polish = (jnp.zeros((d,), bool), nan_d, nan_d)
+    zero_i = jnp.zeros((d,), jnp.int32)
+    no_polish = (jnp.zeros((d,), bool), nan_d, nan_d, zero_i, zero_i, zero_i)
     # the deterministic schemes run no QP: every scheme counter stays 0
     no_stats = SchemeStats(*(jnp.zeros((), jnp.int32) for _ in range(4)))
     with obs_stage(f"backtest/trade_list/{s.method}"):
@@ -72,7 +73,9 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
         polished=polish[0], polish_pre_residual=polish[1],
         polish_post_residual=polish[2],
         qp_solves=stats.qp_solves, sweeps=stats.sweeps,
-        converged_days=stats.converged_days, suffix_len=stats.suffix_len)
+        converged_days=stats.converged_days, suffix_len=stats.suffix_len,
+        anderson_accepted=polish[3], anderson_rejected=polish[4],
+        iters_to_converge=polish[5])
 
     if s.universe is not None:
         shifted = masked_shift(w, s.universe, 1, axis=0)

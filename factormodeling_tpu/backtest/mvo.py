@@ -100,7 +100,8 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
                s: SimulationSettings, turnover: bool, risk_model=None,
                warm: ADMMWarmState | None = None, force_fallback=None,
                iters: int | None = None, polish: bool | None = None,
-               polish_passes: int | None = None, vvt=None):
+               polish_passes: int | None = None, vvt=None,
+               kernel: str | None = None):
     """One date's MVO solve with the full fallback ladder.
 
     ``risk_model``: optional ``(loadings [N, k], factor_var [k], idio [N],
@@ -132,14 +133,21 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     scheme-resolved solver budget — the turnover-parallel mode runs its
     seed and sweep stages at reduced budgets (the sequential scan and the
     suffix fallback always use the settings defaults, keeping the exact
-    reference-semantics path untouched). ``vvt`` is the day's precomputed
+    reference-semantics path untouched). ``kernel`` likewise overrides
+    ``s.solver_kernel`` — the parallel mode pins its batched lane solves
+    to ``"reference"`` (the fused kernel exists to collapse the SERIAL
+    dispatch chain, and jax 0.4.x's ``lax.map`` zero-size remainder chunk
+    miscompiles a vmapped ``pallas_call`` when ``d % mvo_batch == 0``
+    — the suffix scan keeps the settings' kernel). ``vvt`` is the day's
+    precomputed
     window Gram ``C @ C.T`` for the sample-covariance path, hoisted across
     outer sweeps (ignored under a risk model, whose Woodbury path never
     forms it).
 
     Returns ``(w [N], primal_residual [], solver_ok [], warm_state,
-    polish)`` — the residual, acceptance flag, and per-day polish telemetry
-    ``(polished [], pre_residual [], post_residual [])`` feed
+    polish)`` — the residual, acceptance flag, and per-day solver telemetry
+    ``(polished [], pre_residual [], post_residual [], aa_accepted [],
+    aa_rejected [], iters_to_converge [])`` feed
     :class:`~factormodeling_tpu.backtest.diagnostics.SolverDiagnostics`;
     ``warm_state`` is the exit iterate for the next day's carry."""
     n = signal_row.shape[0]
@@ -178,7 +186,9 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
         # the hoisted Gram is V@V.T; the solver consumes the SCALED V
         # (2*alpha, c, 2*s_vec leaves V=c unscaled — scaling rides on
         # alpha/s), so the raw window Gram passes through unchanged
-        vvt=vvt if risk_model is None else None)
+        vvt=vvt if risk_model is None else None,
+        anderson=s.qp_anderson,
+        kernel=s.solver_kernel if kernel is None else kernel)
     w = res.x
 
     solver_ok = (jnp.all(jnp.isfinite(w))
@@ -205,11 +215,20 @@ def _solve_day(signal_row: jnp.ndarray, returns: jnp.ndarray, today, w_prev,
     # solve has no meaningful residual
     resid = jnp.where(t_used >= 2, res.primal_residual, jnp.nan)
     # polish telemetry follows the same rule: a discarded solve's polish
-    # stats describe a solution nobody trades
+    # stats describe a solution nobody trades. The tuple also carries the
+    # round-11 solver telemetry: per-day Anderson accept/reset tallies and
+    # (probes-gated; constant 0 otherwise) the iterations-to-converge read
+    # — one stacked pytree through every scheme's scan/vmap.
     solved = solver_ok & (t_used >= 2)
+    i32 = jnp.int32
+    itc = (jnp.zeros((), i32) if res.iters_to_converge is None
+           else res.iters_to_converge)
     polish = (res.polished & solved,
               jnp.where(solved, res.polish_pre_residual, jnp.nan),
-              jnp.where(solved, res.polish_post_residual, jnp.nan))
+              jnp.where(solved, res.polish_post_residual, jnp.nan),
+              jnp.where(solved, res.aa_accepted, 0).astype(i32),
+              jnp.where(solved, res.aa_rejected, 0).astype(i32),
+              jnp.where(solved, itc, 0).astype(i32))
     # a REJECTED solve's iterates describe a problem whose solution was
     # discarded (the traded w is the fallback) — carrying them would seed
     # tomorrow's reduced warm budget with an inconsistent start; reset that
@@ -361,19 +380,19 @@ def _nan_signal_days(signal: jnp.ndarray, s: SimulationSettings):
 
 def _turnover_day_solve(signal, s: SimulationSettings, stacks, zero_day,
                         nan_sig_day, today, w_prev, warm, vvt=None,
-                        iters=None, polish_passes=None):
+                        iters=None, polish_passes=None, kernel=None):
     """One turnover day's solve + ladder masking — THE day step. Shared by
     the sequential scan, the parallel sweeps, and the sequential-suffix
     fallback so the three paths cannot drift apart semantically (the
     fallback's bit-for-bit contract with the scan rides on this sharing);
-    the sweep/suffix-only knobs (``vvt`` hoist, reduced budgets) default
-    off for the scan."""
+    the sweep/suffix-only knobs (``vvt`` hoist, reduced budgets, lane
+    ``kernel`` pin) default off for the scan."""
     rm = None if stacks is None else _risk_model_for_day(stacks, today, s)
     w, resid, ok, state, polish = _solve_day(
         signal[today], s.returns, today, w_prev, s, turnover=True,
         risk_model=rm, warm=warm if s.qp_warm_start else None,
         force_fallback=nan_sig_day[today], vvt=vvt, iters=iters,
-        polish_passes=polish_passes)
+        polish_passes=polish_passes, kernel=kernel)
     w = jnp.where(zero_day[today], 0.0, w)
     return w, resid, ok, state, polish
 
@@ -519,12 +538,19 @@ def _mvo_turnover_parallel(signal: jnp.ndarray, s: SimulationSettings):
         return None if grams is None else grams[today]
 
     # ---- 1. seed trajectory: batched plain-MVO (lax.map slices the ragged
-    # tail instead of padding, like mvo_weights)
+    # tail instead of padding, like mvo_weights). Lane solves pin
+    # kernel="reference": the fused segment kernel exists to collapse the
+    # SERIAL dispatch chain (lanes are already batched, so it buys nothing
+    # here), and jax 0.4.x's lax.map emits a zero-size remainder chunk when
+    # d % batch == 0 whose vmapped pallas_call fails to lower. The suffix
+    # scan below — the serial path the kernel targets — keeps the settings'
+    # kernel.
     def seed_one(today):
         w, _, _, state, _ = _solve_day(
             signal[today], s.returns, today, jnp.zeros(n, dtype), s,
             turnover=False, risk_model=rm_for(today),
-            iters=s.resolved_seed_iters(), polish=False, vvt=vvt_for(today))
+            iters=s.resolved_seed_iters(), polish=False, vvt=vvt_for(today),
+            kernel="reference")
         return jnp.where(zero_day[today], 0.0, w), state
 
     with jax.named_scope("backtest/turnover_seed"):
@@ -537,13 +563,16 @@ def _mvo_turnover_parallel(signal: jnp.ndarray, s: SimulationSettings):
             signal, s, stacks, zero_day, nan_sig_day, today, w_prev_row,
             ADMMWarmState(z=z, u=u, rho=rho), vvt=vvt_for(today),
             iters=s.resolved_sweep_iters(),
-            polish_passes=s.turnover_polish_passes)
+            polish_passes=s.turnover_polish_passes,
+            kernel="reference")
 
     nan_d = jnp.full((d,), jnp.nan, dtype)
+    zero_i = jnp.zeros((d,), jnp.int32)
     inf = jnp.asarray(jnp.inf, dtype)
     carry0 = (traj0, st0.z, st0.u, st0.rho,
               nan_d, jnp.ones((d,), bool),                    # resid, ok
-              (jnp.zeros((d,), bool), nan_d, nan_d),          # polish
+              (jnp.zeros((d,), bool), nan_d, nan_d,           # polish +
+               zero_i, zero_i, zero_i),                       # aa/iters
               jnp.full((d,), jnp.inf, dtype),                 # per-day delta
               inf,                                            # last max delta
               jnp.zeros((), bool),                            # done
@@ -661,10 +690,14 @@ def _finalize(w, signal, s, pos, neg, flat, resid, ok, polish, stats):
     # flat / no-history days never reach the solver's accept branch; mark
     # them ok so diagnostics only flag genuine solver fallbacks
     ok = ok | zero_day | no_hist
-    # ...and their (discarded) polish telemetry is meaningless
+    # ...and their (discarded) polish/solver telemetry is meaningless
     dead = zero_day | no_hist
-    polished, pre, post = polish
+    polished, pre, post, aa_acc, aa_rej, itc = polish
+    zero_i = jnp.zeros((), aa_acc.dtype)
     polish = (polished & ~dead, jnp.where(dead, jnp.nan, pre),
-              jnp.where(dead, jnp.nan, post))
+              jnp.where(dead, jnp.nan, post),
+              jnp.where(dead, zero_i, aa_acc),
+              jnp.where(dead, zero_i, aa_rej),
+              jnp.where(dead, zero_i, itc))
     return (w, jnp.where(zero_day, zero, lc), jnp.where(zero_day, zero, sc),
             resid, ok, polish, stats)

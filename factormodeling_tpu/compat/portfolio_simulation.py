@@ -26,6 +26,7 @@ from factormodeling_tpu.backtest import (
     daily_trade_list as _dense_trade_list,
 )
 from factormodeling_tpu.backtest.diagnostics import (SolverDiagnostics,
+                                                     anderson_stats,
                                                      check_anomalies,
                                                      polish_stats,
                                                      sweep_stats)
@@ -131,8 +132,9 @@ def _record_sim(name: str, method: str, diag: SolverDiagnostics,
         "anomalies": n_anomalies,
         "polish": polish_stats(diag),
         # scheme telemetry (qp_solves; the turnover-parallel sweep count,
-        # certified prefix, and sequential-suffix length land here)
-        "solver": sweep_stats(diag),
+        # certified prefix, and sequential-suffix length land here, plus
+        # the round-11 Anderson accept/reset tallies)
+        "solver": {**sweep_stats(diag), **anderson_stats(diag)},
     })
     if cost is not None:
         rep.record(f"compat/sim/{name}", kind="cost", **cost)
@@ -145,9 +147,9 @@ def _fused_run_device(sig, uni, s: _DenseSettings, s_full: _DenseSettings):
     then P&L on the universe-masked weights under the full-grid settings
     (exactly the arrays the pandas weights round trip would rebuild).
 
-    Everything the host consumes per run lands in ONE packed [20, D] f32
+    Everything the host consumes per run lands in ONE packed [22, D] f32
     array, so the pandas boundary pays a single device fetch instead of
-    ~20 relay round trips (counts, six result columns, eight per-day
+    ~20 relay round trips (counts, six result columns, ten per-day
     diagnostics, four broadcast scheme-telemetry scalars)."""
     w, lc, sc, diag = _dense_trade_list(sig, s)
     wv = jnp.where(uni, w, jnp.nan)
@@ -165,7 +167,9 @@ def _fused_run_device(sig, uni, s: _DenseSettings, s_full: _DenseSettings):
            diag.active.astype(f32), diag.polished.astype(f32),
            diag.polish_pre_residual, diag.polish_post_residual,
            scal(diag.qp_solves), scal(diag.sweeps),
-           scal(diag.converged_days), scal(diag.suffix_len)])
+           scal(diag.converged_days), scal(diag.suffix_len),
+           jnp.broadcast_to(jnp.asarray(diag.anderson_accepted, f32), (d,)),
+           jnp.broadcast_to(jnp.asarray(diag.anderson_rejected, f32), (d,))])
     return w, res, packed
 
 
@@ -185,7 +189,7 @@ def _finalize_result(frame: pd.DataFrame, res, symbols: pd.Index,
 
 def _unpack(packed: np.ndarray):
     """(result columns dict, lc, sc, SolverDiagnostics) from the packed
-    [20, D] host array."""
+    [22, D] host array."""
     cols = {c: packed[i] for i, c in enumerate(_RESULT_COLUMNS)}
     lc, sc = packed[6], packed[7]
 
@@ -198,7 +202,9 @@ def _unpack(packed: np.ndarray):
         polished=packed[13] > 0.5, polish_pre_residual=packed[14],
         polish_post_residual=packed[15],
         qp_solves=scal(packed[16]), sweeps=scal(packed[17]),
-        converged_days=scal(packed[18]), suffix_len=scal(packed[19]))
+        converged_days=scal(packed[18]), suffix_len=scal(packed[19]),
+        anderson_accepted=packed[20].astype(np.int64),
+        anderson_rejected=packed[21].astype(np.int64))
     return cols, lc, sc, diag
 
 

@@ -83,6 +83,11 @@ class StageCounters(NamedTuple):
       (executed Picard sweeps, certified-converged prefix length,
       sequential-fallback suffix length; see
       ``backtest.diagnostics.SchemeStats``).
+    anderson_accepted / anderson_rejected: ``int32[]`` — Anderson-
+      acceleration extrapolation steps taken vs safeguard resets summed
+      over the run's ADMM solves (0 with ``qp_anderson=0``; a high reject
+      share means the safeguard carried the solve — see
+      ``backtest.diagnostics.SolverDiagnostics``).
     """
 
     universe_size: jnp.ndarray
@@ -99,6 +104,8 @@ class StageCounters(NamedTuple):
     turnover_sweeps: jnp.ndarray
     turnover_converged_days: jnp.ndarray
     turnover_suffix_len: jnp.ndarray
+    anderson_accepted: jnp.ndarray
+    anderson_rejected: jnp.ndarray
 
 
 def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
@@ -148,6 +155,10 @@ def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
         turnover_sweeps=jnp.asarray(diag.sweeps, jnp.int32),
         turnover_converged_days=jnp.asarray(diag.converged_days, jnp.int32),
         turnover_suffix_len=jnp.asarray(diag.suffix_len, jnp.int32),
+        anderson_accepted=jnp.asarray(
+            diag.anderson_accepted).sum().astype(jnp.int32),
+        anderson_rejected=jnp.asarray(
+            diag.anderson_rejected).sum().astype(jnp.int32),
     )
 
 

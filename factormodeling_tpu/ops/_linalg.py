@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["spd_solve"]
+__all__ = ["aa_mix", "spd_solve"]
 
 
 def spd_solve(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -42,3 +42,38 @@ def spd_solve(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
     aug = lax.fori_loop(0, f, step, aug, unroll=True)
     return aug[..., -1]
+
+
+def aa_mix(v_f: jnp.ndarray, g: jnp.ndarray, s_hist: jnp.ndarray,
+           y_hist: jnp.ndarray, hist_len, *, reg: float = 1e-8) -> jnp.ndarray:
+    """Type-II Anderson-acceleration candidate from difference histories.
+
+    For a fixed-point iteration ``v -> F(v)`` with residual ``g(v) = F(v) - v``,
+    the depth-``m`` AA-II extrapolation (Walker & Ni 2011; the safeguarded
+    scheme of Zhang, O'Donoghue & Boyd) is::
+
+        gamma = argmin || g_k - Y' gamma ||_2
+        v_aa  = F(v_k) - gamma @ (S + Y)
+
+    with ``S``/``Y`` the last ``hist_len <= m`` iterate / residual difference
+    rows (row ``j`` = step ``k - j`` minus step ``k - j - 1``). The masked
+    normal equations run through :func:`spd_solve` — the same pivot-free
+    batched small-system path the library uses everywhere — with a relative
+    Tikhonov ridge (``reg * mean diag``), so a rank-deficient history (stalled
+    iterates, duplicated residuals) degrades toward the plain step instead of
+    blowing up. Unused history rows are decoupled to an identity block and
+    contribute an exact-zero ``gamma``; at ``hist_len == 0`` the candidate IS
+    ``v_f``. Shapes: ``v_f``/``g`` ``[n]``, ``s_hist``/``y_hist`` ``[m, n]``;
+    ``hist_len`` may be traced. Everything is plain jnp/lax, so the helper is
+    usable inside ``vmap``/``scan`` bodies and Pallas kernels alike.
+    """
+    m = s_hist.shape[0]
+    dtype = g.dtype
+    mask = (jnp.arange(m) < hist_len).astype(dtype)
+    ym = y_hist * mask[:, None]
+    a = ym @ ym.T                                     # [m, m]
+    ridge = reg * jnp.trace(a) / jnp.maximum(
+        hist_len, 1).astype(dtype) + jnp.finfo(dtype).tiny
+    a = a + jnp.diag(1.0 - mask) + ridge * jnp.eye(m, dtype=dtype)
+    gamma = spd_solve(a, ym @ g)
+    return v_f - gamma @ ((s_hist + y_hist) * mask[:, None])

@@ -1,0 +1,254 @@
+"""Fused Pallas kernel for one ADMM segment: a whole ``_ADAPT_EVERY``-
+iteration block of the box/L1 QP solver as ONE dispatch.
+
+Why: the turnover backtest is serial-dependency bound (BENCH_r05:
+``hbm_frac ~ 8e-5``, neither roofline axis binds) — each day's solve is a
+chain of ~100 latency-bound small matvec dispatches (x-step Woodbury apply,
+relaxation, soft-threshold z-step, dual update), and architecture.md §14
+closed the day-parallel escape. This kernel keeps the whole ``[T, N]``
+operand set VMEM-resident and loops the segment's iterations on-chip, so a
+40-iteration warm solve becomes ~2 dispatches (one per adaptive-rho
+segment) instead of ~160 XLA ops' worth of dispatch/latency chain. The
+adaptive-rho refactorization stays OUTSIDE the kernel (it is O(T^3) work a
+handful of times per solve, and ``jax.scipy`` Cholesky does not exist in
+Mosaic): the caller (``solvers/admm_qp.py::admm_solve_lowrank``) hands the
+kernel explicit small inverses (the Woodbury inner inverse ``kinv``, the
+equality Schur inverse folded into ``ge``/``xb``) so the in-kernel
+iteration is pure matmul/elementwise work.
+
+Semantics are the reference XLA loop's, iteration for iteration — same
+x-step algebra (rearranged: the per-iteration Cholesky back-substitutions
+become matmuls against the precomputed inverses, which reassociates floats
+but changes nothing else), same over-relaxation, prox, dual update, and the
+same optional safeguarded Anderson accelerator (sharing
+:func:`factormodeling_tpu.ops._linalg.aa_mix` — literally the same mixing
+code runs inside the kernel). The solver-level differential fuzz pins
+fused-vs-reference agreement at <= 1e-6 across the corpus.
+
+Like the rank kernels, CPU runs in interpret mode (the kernel body lowers
+to plain XLA — a regression-safe functional path) and TPU takes the
+compiled Mosaic path; the compiled path follows the established idioms
+(lane-padded operands, [8, 128]-tiled scalar outputs, rolled fori_loop) but
+its wall-clock awaits the next driver TPU bench run, as with every kernel
+in this repo. Asset widths are padded to the 128-lane multiple with inert
+values (d=1, bounds=0 pins padded coordinates at zero through every
+iteration); the window/equality axes pad to the 8-sublane multiple with
+zeros.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from factormodeling_tpu.ops._linalg import aa_mix
+
+try:  # TPU memory spaces; absent on CPU-only installs of some versions
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["admm_segment"]
+
+_LANES = 128
+_SUB = 8
+
+# packed-operand row layout ([16, Np]): the per-coordinate vectors the
+# iteration reads, one VMEM tile instead of ten tiny arguments
+_ROWS = ("q", "lo", "hi", "center", "thresh", "d", "xb", "rho", "z0", "u0")
+
+
+def _pad_to(x, rows=None, lanes=None, fill=0.0):
+    pr = 0 if rows is None else -x.shape[0] % rows
+    plc = 0 if lanes is None else -x.shape[-1] % lanes
+    if pr or plc:
+        x = jnp.pad(x, [(0, pr), (0, plc)][2 - x.ndim:],
+                    constant_values=fill)
+    return x
+
+
+def _kernel(p_ref, v_ref, k_ref, mt_ref, ge_ref, out_ref, st_ref, *,
+            seg_len: int, relax: float, anderson: int, collect: bool,
+            last: bool, safeguard: float, step_clamp: float,
+            plain_tail: int, conv_tol: float):
+    pk = p_ref[...]                                 # [16, Np]
+    V = v_ref[...]                                  # [Tp, Np]
+    kin = k_ref[...]                                # [Tp, Tp]
+    mt = mt_ref[...]                                # [Kp, Np] = minv_et.T
+    ge = ge_ref[...]                                # [Kp, Np] = Ginv @ E
+    dtype = pk.dtype
+    qv, lov, hiv, cv, thr, dv, xbv, rhov, z0, u0 = (
+        pk[i:i + 1] for i in range(10))
+    rho = rhov[0, 0]
+
+    def plain(z, u):
+        # x-step: Woodbury apply against the precomputed inner inverse,
+        # then the equality correction folded into ge/xb
+        rd = (rhov * (z - u) - qv) / dv
+        t2 = (rd @ V.T) @ kin                       # [1, Tp]
+        xt = rd - (t2 @ V) / dv
+        x = xt - (xt @ ge.T) @ mt + xbv
+        xr = relax * x + (1.0 - relax) * z          # over-relaxation
+        w = xr + u
+        zs = w - cv                                 # soft-threshold prox
+        z_new = cv + jnp.sign(zs) * jnp.maximum(jnp.abs(zs) - thr, 0.0)
+        z_new = jnp.clip(z_new, lov, hiv)
+        return x, z_new, w - z_new
+
+    def conv_update(conv, k, x, z_new, dz):
+        r_c = jnp.maximum(jnp.max(jnp.abs(x - z_new)), rho * dz)
+        return jnp.where((conv == 0.0) & (r_c <= conv_tol),
+                         jnp.asarray(k, dtype).astype(dtype), conv)
+
+    zeros = jnp.zeros((), dtype)
+
+    if anderson == 0:
+        def body(i, st):
+            x, z, u, _, conv = st
+            x, z_new, u = plain(z, u)
+            dz = jnp.max(jnp.abs(z_new - z))
+            if collect:
+                conv = conv_update(conv, i + 1.0, x, z_new, dz)
+            return x, z_new, u, dz, conv
+
+        x, z, u, dz, conv = jax.lax.fori_loop(
+            0, seg_len, body, (z0, z0, u0, zeros, zeros))
+        acc = rej = zeros
+    else:
+        m = anderson
+        n2 = 2 * z0.shape[-1]
+
+        def body(i, st):
+            (x, z, u, _, s_h, y_h, vp, gp, vg, hist, r_best, acc, rej,
+             conv) = st
+            x, z_new, u_new = plain(z, u)
+            dz = jnp.max(jnp.abs(z_new - z))
+            if collect:
+                conv = conv_update(conv, i + 1.0, x, z_new, dz)
+            v = jnp.concatenate([z, u], axis=1)[0]
+            v_f = jnp.concatenate([z_new, u_new], axis=1)[0]
+            g = v_f - v
+            r = jnp.sqrt(g @ g)
+            # best-so-far growth envelope with rollback + bounded
+            # extrapolation — see the reference body in solvers/admm_qp.py
+            # for the rationale
+            grew = (i > 0) & (r > safeguard * r_best)
+            vg = jnp.where(r <= r_best, v_f, vg)
+            r_best = jnp.minimum(r_best, r)
+            rej = rej + grew.astype(dtype)
+            hist = jnp.where(grew, 0.0, hist)
+            push = (i > 0) & ~grew
+            s_h = jnp.where(push,
+                            jnp.roll(s_h, 1, axis=0).at[0].set(v - vp), s_h)
+            y_h = jnp.where(push,
+                            jnp.roll(y_h, 1, axis=0).at[0].set(g - gp), y_h)
+            hist = jnp.where(push, jnp.minimum(hist + 1.0, 1.0 * m), hist)
+            cand = aa_mix(v_f, g, s_h, y_h, hist)
+            step = cand - v_f
+            r_c = jnp.maximum(jnp.max(jnp.abs(x - z_new)), rho * dz)
+            use = ((hist > 0) & ~grew & (r <= r_best) & (r_c > conv_tol)
+                   & (jnp.sqrt(step @ step) <= step_clamp * r)
+                   & jnp.all(jnp.isfinite(cand)))
+            if last:
+                use = use & (i < seg_len - plain_tail)
+            acc = acc + use.astype(dtype)
+            v_next = jnp.where(use, cand, v_f)
+            v_next = jnp.where(grew, vg, v_next)
+            return (x, v_next[None, :z.shape[-1]],
+                    v_next[None, z.shape[-1]:], dz, s_h, y_h, v, g, vg,
+                    hist, r_best, acc, rej, conv)
+
+        h0 = jnp.zeros((m, n2), dtype)
+        v00 = jnp.zeros((n2,), dtype)
+        st = jax.lax.fori_loop(
+            0, seg_len, body,
+            (z0, z0, u0, zeros, h0, h0, v00, v00,
+             jnp.concatenate([z0, u0], axis=1)[0], zeros,
+             jnp.asarray(jnp.inf, dtype), zeros, zeros, zeros))
+        x, z, u, dz = st[:4]
+        acc, rej, conv = st[11:]
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (_SUB, x.shape[-1]), 0)
+    out_ref[...] = jnp.where(rows == 0, x,
+                             jnp.where(rows == 1, z,
+                                       jnp.where(rows == 2, u, 0.0)))
+    srow = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANES), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_SUB, _LANES), 1)
+    stats = jnp.where((srow == 0) & (lane == 0), dz,
+                      jnp.where((srow == 0) & (lane == 1), acc,
+                                jnp.where((srow == 0) & (lane == 2), rej,
+                                          jnp.where((srow == 0) & (lane == 3),
+                                                    conv, 0.0))))
+    st_ref[...] = stats.astype(dtype)
+
+
+def admm_segment(d, V, kinv, minv_et_t, ge, xb, q, lo, hi, center, thresh,
+                 z, u, rho, *, relax: float, seg_len: int, last: bool,
+                 anderson: int, collect: bool, interpret: bool):
+    """One fused ADMM segment: ``seg_len`` iterations at fixed ``rho``.
+
+    Vector operands are ``[n]`` in the solver's scaled units; ``V`` is the
+    ``[T, n]`` low-rank factor, ``kinv`` the ``[T, T]`` Woodbury inner
+    inverse at this rho, ``minv_et_t``/``ge`` the ``[K, n]`` equality
+    operators (``(P + rho I)^{-1} E')'`` and ``Ginv E``) and ``xb`` the
+    constant equality offset ``Minv_Et Ginv b``. Returns
+    ``(x, z, u, dz, aa_accepted, aa_rejected, conv_local)`` matching the
+    reference segment body: the last plain x-step iterate, the prox-exact
+    exit (z, u), the final z-movement (for the dual residual), the
+    Anderson tallies, and — when ``collect`` — the 1-based in-segment
+    iteration at which the combined residual first reached the
+    iters-to-converge tolerance (0 otherwise). ``seg_len``/``last``/
+    ``anderson``/``collect`` are trace-time static, as is ``relax``.
+    """
+    from factormodeling_tpu.solvers.admm_qp import (_AA_PLAIN_TAIL,
+                                                    _AA_SAFEGUARD,
+                                                    _AA_STEP_CLAMP, _CONV_TOL)
+
+    dtype = V.dtype
+    n = q.shape[-1]
+    rows = [q, lo, hi, center, thresh, d, xb,
+            jnp.broadcast_to(jnp.asarray(rho, dtype), (n,)), z, u]
+    packed = jnp.stack([r.astype(dtype) for r in rows])       # [10, n]
+    # inert lane padding: d=1 divides safely, lo=hi=0 pins the padded
+    # coordinates at zero through every iteration (verified: every padded
+    # intermediate stays exactly 0)
+    packed = _pad_to(packed, rows=16, lanes=_LANES)
+    packed = packed.at[5, n:].set(1.0) if packed.shape[-1] > n else packed
+    vp = _pad_to(V, rows=_SUB, lanes=_LANES)
+    tp = vp.shape[0]
+    kp = _pad_to(kinv, rows=tp, lanes=tp)
+    # equality operators block to their own padded row count: K > 8 rows
+    # must all enter the correction contraction (a hard-coded _SUB block
+    # would silently read only the first 8 — zero-padded rows are inert,
+    # truncated real rows are a wrong answer)
+    mtp = _pad_to(minv_et_t, rows=_SUB, lanes=_LANES)
+    gep = _pad_to(ge, rows=_SUB, lanes=_LANES)
+    kk = mtp.shape[0]
+    np_ = packed.shape[-1]
+
+    out, st = pl.pallas_call(
+        functools.partial(_kernel, seg_len=int(seg_len), relax=float(relax),
+                          anderson=int(anderson), collect=bool(collect),
+                          last=bool(last), safeguard=float(_AA_SAFEGUARD),
+                          step_clamp=float(_AA_STEP_CLAMP),
+                          plain_tail=int(_AA_PLAIN_TAIL),
+                          conv_tol=float(_CONV_TOL)),
+        grid=(1,),
+        in_specs=[pl.BlockSpec((16, np_), lambda i: (0, 0)),
+                  pl.BlockSpec((tp, np_), lambda i: (0, 0)),
+                  pl.BlockSpec((tp, tp), lambda i: (0, 0)),
+                  pl.BlockSpec((kk, np_), lambda i: (0, 0)),
+                  pl.BlockSpec((kk, np_), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((_SUB, np_), lambda i: (0, 0)),
+                   pl.BlockSpec((_SUB, _LANES), lambda i: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((_SUB, np_), dtype),
+                   jax.ShapeDtypeStruct((_SUB, _LANES), dtype)],
+        interpret=interpret,
+    )(packed, vp, kp, mtp, gep)
+    i32 = jnp.int32
+    return (out[0, :n], out[1, :n], out[2, :n], st[0, 0],
+            st[0, 1].astype(i32), st[0, 2].astype(i32),
+            st[0, 3].astype(i32))

@@ -61,6 +61,7 @@ TPU design notes:
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import NamedTuple
 
 import jax
@@ -68,6 +69,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from factormodeling_tpu.obs import probes as _obs_probes
+from factormodeling_tpu.ops._linalg import aa_mix as _aa_mix
 
 __all__ = ["ADMMWarmState", "BoxQPProblem", "admm_solve_dense",
            "admm_solve_lowrank"]
@@ -104,6 +106,19 @@ class ADMMResult(NamedTuple):
     # obs.probe("solver/admm/residual_traj", res.residual_traj)); inside
     # the engine's scan/map consumers it is unused and DCE'd away.
     residual_traj: jnp.ndarray | None = None
+    # Anderson-acceleration tallies (int32 scalars): extrapolation steps
+    # taken vs safeguard resets over the whole solve. Exact zeros (constants,
+    # not loop carries) when the accelerator is off, so the default path's
+    # loop HLO is untouched.
+    aa_accepted: jnp.ndarray | int = 0
+    aa_rejected: jnp.ndarray | int = 0
+    # first iteration (1-based, counted across segments) at which the
+    # combined residual max(r_prim, rho * dz) dropped to _CONV_TOL — the
+    # "loop has done its job, polish can identify" grade — or 0 when the
+    # budget ran out first. Collected under the same probes gate as
+    # residual_traj (None otherwise: structurally absent, production graph
+    # untouched).
+    iters_to_converge: jnp.ndarray | None = None
 
     @property
     def warm_state(self) -> "ADMMWarmState":
@@ -141,6 +156,55 @@ def _soft(a, k):
 
 _ADAPT_EVERY = 25          # iterations per segment between rho updates
 _UNROLL = 25               # TPU inner-loop unroll factor (see _unroll_factor)
+_AA_DEPTH = 5              # default Anderson history depth (the `anderson`
+                           # argument; 0 disables — the bit-stable default)
+_AA_SAFEGUARD = 2.0        # max fixed-point-residual growth over the BEST
+                           # residual seen so far before the accelerator is
+                           # blamed: the plain (relaxed) ADMM map is averaged
+                           # nonexpansive, so a residual that DOUBLES can only
+                           # come from the last Anderson extrapolation — drop
+                           # the history AND ROLL BACK to the best-known
+                           # iterate (continuing from the poisoned point was
+                           # measured to burn the rest of the segment
+                           # re-contracting: one bad jump to |x| ~ 1e2 left
+                           # the exit residual at 1e0 on the golden panel's
+                           # cold day-2 solve), then take plain steps until
+                           # a new best re-engages the history
+_AA_PLAIN_TAIL = 5         # unaccelerated iterations closing every solve:
+                           # the exit z seeds BOTH the polish's active-set
+                           # equality reads and tomorrow's warm start, and
+                           # an extrapolated iterate near the exit leaves
+                           # residue in (z, u) that one plain step cannot
+                           # clear — measured on the warm golden chain as a
+                           # single mis-identified day poisoning the next
+                           # ~6 days' warm carries (gap 4e-2 decaying
+                           # geometrically). A short plain tail re-contracts
+                           # to the natural ADMM fixed point before exit
+                           # (swept 1/3/5/8 on the goldens: 1 leaves the
+                           # COLD chain one mis-identified day, 3 suffices,
+                           # 5 carries margin, 8 wastes budget).
+_AA_STEP_CLAMP = 5.0       # max extrapolation length as a multiple of the
+                           # current fixed-point residual: aa_mix's
+                           # least-squares gamma is unbounded when the
+                           # residual-difference matrix is near-singular
+                           # (the L1 problem stalls iterates, duplicating
+                           # history rows), and ONE unclamped candidate late
+                           # in a segment wrecks the exit iterate before the
+                           # growth test can see it. Swept 5/10/20 on the
+                           # warm golden chain: 10+ re-admits the wreckers
+                           # (warm gap 5.6e-3, 26/27 accepts), 5 keeps all
+                           # 27/27 at gap 1.3e-4 while still cutting the
+                           # warm budget 40 -> 20
+_CONV_TOL = 1e-3           # combined-residual threshold (scaled units) of the
+                           # iters-to-converge telemetry: the residual grade
+                           # at which the guarded polish reliably identifies
+                           # the active set on the goldens — "converged" here
+                           # means "the loop has done its job and the polish
+                           # can take over", not eps-optimality
+_FUSED_SEGMENT_MAX_N = 4096  # fused-kernel width guard: beyond this the
+                           # VMEM-resident [T, N] operand set outgrows the
+                           # 16 MB scoped budget and the dispatch falls back
+                           # to the reference path at trace time
 _RHO_STEP_CLIP = 5.0       # max per-update rho movement factor
 _RHO_BOUNDS = (1e-4, 1e7)  # global rho clamp (scaled problem units)
 _POLISH_DELTA = 1e-8       # polish KKT regularization (scaled units; the
@@ -401,13 +465,32 @@ def _unroll_factor() -> int:
     from 1.31 s to 0.48 s at 1332x1000. XLA's *CPU* pipeline, however, has been
     observed to segfault compiling the fully-unrolled body, so every other
     backend keeps the rolled loop.
+
+    ``FMT_ADMM_UNROLL`` overrides the backend default (read at trace time,
+    like the backend probe): a positive integer forces that unroll on ANY
+    backend — ``1`` forces the rolled loop on TPU (e.g. to bound compile
+    time in a many-variant sweep), larger values opt a non-TPU backend into
+    unrolling. Anything unparseable or non-positive is ignored. The FUSED
+    segment kernel (``kernel="fused"``) ignores this knob entirely: its
+    iterations run inside one Pallas program where XLA-level unrolling is
+    meaningless (there is no while-loop dispatch overhead to amortize), so
+    the env var only shapes the reference path.
     """
+    raw = os.environ.get("FMT_ADMM_UNROLL", "")
+    if raw:
+        try:
+            forced = int(raw)
+        except ValueError:
+            forced = 0
+        if forced > 0:
+            return forced
     return _UNROLL if jax.default_backend() == "tpu" else 1
 
 
 def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
                      relax, warm=None, polish_ops=None,
-                     polish_passes: int = _POLISH_PASSES):
+                     polish_passes: int = _POLISH_PASSES,
+                     anderson: int = 0, fused_segment=None):
     """Shared ADMM loop with residual-balanced adaptive rho.
 
     ``make_solver(rho)`` returns a function applying (P + rho I)^{-1}; it is
@@ -421,9 +504,38 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
     ``masked_solver(m)`` returns a function applying
     ``(M P M + diag(1 - m) + delta I)^{-1}`` for the free-coordinate mask
     ``m`` (see :func:`_polish_candidate`).
+
+    ``anderson``: history depth m of the safeguarded type-II Anderson
+    accelerator on the (z, u) fixed point (0 — the default — traces the
+    pre-accelerator loop unchanged, bit for bit). Each iteration applies the
+    plain ADMM map F once, then extrapolates the NEXT iterate from the last
+    m iterate/residual difference pairs (:func:`~factormodeling_tpu.ops.
+    _linalg.aa_mix`). Three safeguards keep the L1 kink and box projections
+    from destabilizing it:
+
+    - residual growth beyond ``_AA_SAFEGUARD`` between consecutive
+      iterations is blamed on the last extrapolation (the plain relaxed
+      ADMM map is averaged nonexpansive, so it cannot double the residual
+      by itself): the history is dropped and the plain step taken;
+    - a non-finite candidate falls back to the plain step;
+    - the history resets at every segment boundary (each rho
+      refactorization rescales the dual, invalidating the secant pairs),
+      and the FINAL iteration always takes the plain step, so the exit
+      ``z`` is an exact prox output — the polish's active-set equality
+      reads and the warm-start contract are untouched by acceleration.
+
+    Accept/reset tallies ride ``ADMMResult.aa_accepted/aa_rejected``.
+
+    ``fused_segment``: optional callable ``(z, u, rho, seg_len, last) ->
+    (x, z, u, dz, aa_acc, aa_rej, conv_local)`` running one whole segment as
+    a single Pallas dispatch (``ops/_pallas_admm.py``); when set it replaces
+    the inner iteration loop (the residual-balancing tail is shared) and the
+    segment schedule is always the static Python one — ``_unroll_factor()``
+    is meaningless inside a Pallas program and is deliberately not consulted.
     """
     n = q.shape[-1]
     dtype = q.dtype
+    i32 = jnp.int32
 
     def factor(rho):
         solve_m = make_solver(rho)
@@ -442,26 +554,146 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
         moved = prob.center + _soft(v - prob.center, l1 / rho)
         return jnp.clip(moved, prob.lo, prob.hi)
 
-    def segment(carry, seg_len, unroll):
+    collect = _obs_probes.collection_active()
+
+    def conv_update(conv, k, x, z_new, dz, rho):
+        """First 1-based global iteration k at which the combined residual
+        reached the polish-identification grade (iters-to-converge
+        telemetry; probes-gated, so the production graph never pays the two
+        extra reductions)."""
+        r_c = jnp.maximum(jnp.max(jnp.abs(x - z_new)), rho * dz)
+        return jnp.where((conv == 0) & (r_c <= _CONV_TOL),
+                         jnp.asarray(k, i32), conv)
+
+    def segment(carry, seg_len, unroll, extras, it_base, last):
         # seg_len: number of body iterations this segment (static on the
-        # unrolled path, traced on the rolled path — both sum to `iters`).
+        # unrolled/fused paths, traced on the rolled path — all sum to
+        # `iters`). extras: (aa_acc, aa_rej, conv) int32 scalars, or None on
+        # the untracked default path (anderson off, no fused kernel, probes
+        # off) so its loop carries stay byte-identical to the
+        # pre-accelerator trace. it_base/last locate the segment in the
+        # global schedule (traced on the rolled path).
         x, z, u, rho = carry
-        fac = factor(rho)
+        zero = jnp.zeros((), dtype)
 
-        def body(_, st):
-            x, z, u, _ = st
-            x = x_step(fac, z, u, rho)
-            xr = relax * x + (1.0 - relax) * z       # over-relaxation
-            z_new = z_step(xr + u, rho)
-            u = u + xr - z_new
-            dz = jnp.max(jnp.abs(z_new - z))         # for the dual residual
-            return x, z_new, u, dz
+        if fused_segment is not None:
+            acc, rej, conv = extras
+            x, z, u, dz, acc2, rej2, conv2 = fused_segment(
+                z, u, rho, seg_len, last)
+            acc, rej = acc + acc2, rej + rej2
+            if collect:
+                conv = jnp.where((conv == 0) & (conv2 > 0),
+                                 it_base + conv2, conv)
+            extras = (acc, rej, conv)
+        elif anderson == 0:
+            fac = factor(rho)
 
-        # omit unroll on the rolled path: seg_len is traced there, and some
-        # jax releases reject any explicit unroll with dynamic loop bounds
-        x, z, u, dz = lax.fori_loop(
-            0, seg_len, body, (x, z, u, jnp.zeros((), dtype)),
-            unroll=unroll if unroll != 1 else None)
+            def body(i, st):
+                x, z, u, _ = st[:4]
+                x = x_step(fac, z, u, rho)
+                xr = relax * x + (1.0 - relax) * z   # over-relaxation
+                z_new = z_step(xr + u, rho)
+                u = u + xr - z_new
+                dz = jnp.max(jnp.abs(z_new - z))     # for the dual residual
+                if extras is None:
+                    return x, z_new, u, dz
+                acc, rej, conv = st[4:]
+                if collect:
+                    conv = conv_update(conv, it_base + i + 1, x, z_new, dz,
+                                       rho)
+                return x, z_new, u, dz, acc, rej, conv
+
+            st0 = (x, z, u, zero) + (extras if extras is not None else ())
+            # omit unroll on the rolled path: seg_len is traced there, and
+            # some jax releases reject explicit unroll with dynamic bounds
+            st = lax.fori_loop(0, seg_len, body, st0,
+                               unroll=unroll if unroll != 1 else None)
+            x, z, u, dz = st[:4]
+            extras = st[4:] if extras is not None else None
+        else:
+            fac = factor(rho)
+            m = int(anderson)
+            acc0, rej0, conv0 = extras
+
+            def body(i, st):
+                (x, z, u, _, s_h, y_h, vp, gp, vg, hist, r_best, acc, rej,
+                 conv) = st
+                x = x_step(fac, z, u, rho)
+                xr = relax * x + (1.0 - relax) * z
+                z_new = z_step(xr + u, rho)
+                u_new = u + xr - z_new
+                dz = jnp.max(jnp.abs(z_new - z))
+                if collect:
+                    conv = conv_update(conv, it_base + i + 1, x, z_new, dz,
+                                       rho)
+                v = jnp.concatenate([z, u])
+                v_f = jnp.concatenate([z_new, u_new])
+                g = v_f - v
+                r = jnp.sqrt(g @ g)
+                # safeguard: the residual must stay within the factor of the
+                # BEST residual seen so far (not merely the previous one —
+                # per-step tests let sub-factor growths compound
+                # geometrically, measured to destabilize the warm golden
+                # chain). A breach can only come from extrapolation (the
+                # plain map is averaged nonexpansive): drop the history and
+                # ROLL BACK to the best-known plain iterate vg — continuing
+                # from the poisoned point wastes the rest of the segment
+                # re-contracting from wherever the jump landed.
+                grew = (i > 0) & (r > _AA_SAFEGUARD * r_best)
+                vg = jnp.where(r <= r_best, v_f, vg)
+                r_best = jnp.minimum(r_best, r)
+                rej = rej + grew.astype(i32)
+                hist = jnp.where(grew, 0, hist)
+                push = (i > 0) & ~grew
+                s_h = jnp.where(push,
+                                jnp.roll(s_h, 1, axis=0).at[0].set(v - vp),
+                                s_h)
+                y_h = jnp.where(push,
+                                jnp.roll(y_h, 1, axis=0).at[0].set(g - gp),
+                                y_h)
+                hist = jnp.where(push, jnp.minimum(hist + 1, m), hist)
+                cand = _aa_mix(v_f, g, s_h, y_h, hist)
+                # Acceptance gates, each measured necessary on the warm
+                # golden chain (docs/architecture.md section 17):
+                # - improving residual (r <= r_best): the L1 problem is
+                #   FLAT near its optimum, so candidates that merely stay
+                #   inside the growth envelope can wander along the flat
+                #   manifold, scrambling the active set the polish reads;
+                # - bounded extrapolation: a candidate further than
+                #   _AA_STEP_CLAMP residuals from the plain output is a
+                #   least-squares blow-up, not acceleration — its damage
+                #   would only surface NEXT iteration, too late to undo
+                #   cheaply;
+                # - identification grade reached (r_c <= _CONV_TOL): the
+                #   loop's remaining job is handing the polish a clean
+                #   active set, which plain prox steps do and
+                #   extrapolation can only disturb. Warm-started solves
+                #   often START here — acceleration correctly stays off.
+                # The final iteration always exits on the plain step: the
+                # prox output lands EXACTLY on lo/hi/center, which the
+                # polish's active-set equality reads require.
+                step = cand - v_f
+                r_c = jnp.maximum(jnp.max(jnp.abs(x - z_new)), rho * dz)
+                use = ((hist > 0) & ~grew & (r <= r_best)
+                       & (r_c > _CONV_TOL)
+                       & (jnp.sqrt(step @ step) <= _AA_STEP_CLAMP * r)
+                       & ~(last & (i >= seg_len - _AA_PLAIN_TAIL))
+                       & jnp.all(jnp.isfinite(cand)))
+                acc = acc + use.astype(i32)
+                v_next = jnp.where(use, cand, v_f)
+                v_next = jnp.where(grew, vg, v_next)
+                return (x, v_next[:n], v_next[n:], dz, s_h, y_h, v, g, vg,
+                        hist, r_best, acc, rej, conv)
+
+            h0 = jnp.zeros((m, 2 * n), dtype)
+            v0 = jnp.zeros(2 * n, dtype)
+            st0 = (x, z, u, zero, h0, h0, v0, v0,
+                   jnp.concatenate([z, u]), jnp.zeros((), i32),
+                   jnp.asarray(jnp.inf, dtype), acc0, rej0, conv0)
+            st = lax.fori_loop(0, seg_len, body, st0,
+                               unroll=unroll if unroll != 1 else None)
+            x, z, u, dz = st[:4]
+            extras = st[11:]
 
         # residual balancing: r_prim = ||x - z||_inf, r_dual = rho ||dz||_inf;
         # move rho by sqrt(ratio), clipped, and rescale the scaled dual u
@@ -477,7 +709,7 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
         # the per-segment residual pair is the solve's convergence
         # trajectory — returned alongside the carry so the probes-enabled
         # build can record it (unused otherwise; XLA DCEs it away)
-        return (x, z, u, rho_new), jnp.stack((r_prim, r_dual, rho_new))
+        return (x, z, u, rho_new), jnp.stack((r_prim, r_dual, rho_new)), extras
 
     # Problem-aware initial penalty: the z-step soft-threshold moves by
     # l1/rho per iteration, and the useful threshold scale is the typical
@@ -521,22 +753,32 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
     # probes.capture() (a collect_probes=True research step) — a None leaf
     # otherwise, so the production graph and ADMMResult structure are
     # untouched
-    collect_traj = _obs_probes.collection_active()
+    collect_traj = collect
     traj = None
+    # the untracked default path (no accelerator, no fused kernel, probes
+    # off) must trace byte-identically to the pre-accelerator loop, so the
+    # tallies only become carries when something can move them
+    track = anderson > 0 or fused_segment is not None or collect
+    extras = (tuple(jnp.zeros((), i32) for _ in range(3)) if track else None)
     with jax.default_matmul_precision("highest"):
         with jax.named_scope("solver/admm"):
-            if unroll > 1:
-                # TPU: Python-level segment schedule -> static bounds ->
-                # unrolled bodies (each segment traces separately; segment
-                # counts are small). iters=0 still runs one zero-length
-                # segment (its rho balancing sees the untouched iterates),
-                # like the rolled path.
+            if fused_segment is not None or unroll > 1:
+                # TPU / fused kernel: Python-level segment schedule ->
+                # static bounds -> unrolled bodies or single-dispatch
+                # segment kernels (each segment traces separately; segment
+                # counts are small; the kernel needs its iteration count
+                # static). iters=0 still runs one zero-length segment (its
+                # rho balancing sees the untouched iterates), like the
+                # rolled path.
                 schedule = ([min(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
                              for k in range(-(-iters // _ADAPT_EVERY))] or [0])
                 seg_stats = []
-                for seg_len in schedule:
-                    carry, st = segment(carry, seg_len,
-                                        max(min(seg_len, unroll), 1))
+                it_base = 0
+                for si, seg_len in enumerate(schedule):
+                    carry, st, extras = segment(
+                        carry, seg_len, max(min(seg_len, unroll), 1), extras,
+                        it_base, si == len(schedule) - 1)
+                    it_base += seg_len
                     seg_stats.append(st)
                 if collect_traj:
                     traj = jnp.stack(seg_stats)
@@ -548,10 +790,25 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
                 def seg_len_at(k):
                     return jnp.minimum(_ADAPT_EVERY, iters - k * _ADAPT_EVERY)
 
-                if collect_traj:
+                if track:
+                    def seg_k(k, state):
+                        c, ex, buf = state
+                        c, st, ex = segment(c, seg_len_at(k), 1, ex,
+                                            k * _ADAPT_EVERY, k == n_seg - 1)
+                        if collect_traj:
+                            buf = buf.at[k].set(st)
+                        return c, ex, buf
+
+                    carry, extras, traj = lax.fori_loop(
+                        0, n_seg, seg_k,
+                        (carry, extras, jnp.zeros((n_seg, 3), dtype)))
+                    if not collect_traj:
+                        traj = None
+                elif collect_traj:
                     def seg_k(k, state):
                         c, buf = state
-                        c, st = segment(c, seg_len_at(k), 1)
+                        c, st, _ = segment(c, seg_len_at(k), 1, None,
+                                           k * _ADAPT_EVERY, k == n_seg - 1)
                         return c, buf.at[k].set(st)
 
                     carry, traj = lax.fori_loop(
@@ -559,7 +816,8 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
                         (carry, jnp.zeros((n_seg, 3), dtype)))
                 else:
                     def seg_k(k, c):
-                        return segment(c, seg_len_at(k), 1)[0]
+                        return segment(c, seg_len_at(k), 1, None,
+                                       k * _ADAPT_EVERY, k == n_seg - 1)[0]
 
                     carry = lax.fori_loop(0, n_seg, seg_k, carry)
             x, z, u, rho = carry
@@ -596,16 +854,22 @@ def _admm_iterations(make_solver, prob: BoxQPProblem, q, l1, rho0, iters,
                                <= obj_ref + slack))
                 x = jnp.where(accepted, x_p, x)
                 prim = jnp.where(accepted, post_r, prim)
+    aa_acc, aa_rej, conv = (extras if extras is not None
+                            else (jnp.zeros((), i32),) * 3)
     return ADMMResult(x=x, z=z, primal_residual=prim, u=u, rho=rho,
                       polished=accepted, polish_pre_residual=pre_r,
-                      polish_post_residual=post_r, residual_traj=traj)
+                      polish_post_residual=post_r, residual_traj=traj,
+                      aa_accepted=aa_acc, aa_rejected=aa_rej,
+                      iters_to_converge=conv if collect else None)
 
 
 def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
                      iters: int = 500, relax: float = 1.7,
                      warm_start: ADMMWarmState | None = None,
                      polish: bool = True,
-                     polish_passes: int | None = None) -> ADMMResult:
+                     polish_passes: int | None = None,
+                     anderson: int = 0,
+                     kernel: str = "reference") -> ADMMResult:
     """Dense-P path (small n: factor-selection MVO). P must be symmetric PSD.
 
     ``rho`` is the initial penalty; residual balancing adapts it every
@@ -616,7 +880,16 @@ def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
     overrides the default ``_POLISH_PASSES`` active-set refinement budget —
     warm re-solves of an already-identified problem (the turnover-parallel
     sweep lanes) accept from 1-2 passes, and each pass is a
-    refactor-sized masked solve worth skipping."""
+    refactor-sized masked solve worth skipping. ``anderson`` enables the
+    safeguarded Anderson accelerator at that history depth (0 — the default
+    — is bit-identical to the unaccelerated loop; see
+    :func:`_admm_iterations`). ``kernel`` must stay ``"reference"`` here:
+    the fused Pallas segment kernel consumes the Woodbury factors and only
+    exists on the low-rank path (:func:`admm_solve_lowrank`)."""
+    if kernel != "reference":
+        raise ValueError("the fused segment kernel supports the low-rank "
+                         "path only; admm_solve_dense takes "
+                         "kernel='reference'")
     n = P.shape[-1]
     scale = jnp.maximum(jnp.trace(P) / n, 1e-12)
     Ps = P / scale
@@ -641,7 +914,8 @@ def admm_solve_dense(P: jnp.ndarray, prob: BoxQPProblem, *, rho: float = 2.0,
                             warm=warm_start,
                             polish_ops=(mv, masked_solver) if polish else None,
                             polish_passes=(_POLISH_PASSES if polish_passes
-                                           is None else int(polish_passes)))
+                                           is None else int(polish_passes)),
+                            anderson=int(anderson))
 
 
 def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
@@ -650,7 +924,9 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
                        warm_start: ADMMWarmState | None = None,
                        polish: bool = True,
                        polish_passes: int | None = None,
-                       vvt: jnp.ndarray | None = None) -> ADMMResult:
+                       vvt: jnp.ndarray | None = None,
+                       anderson: int = 0,
+                       kernel: str = "reference") -> ADMMResult:
     """Low-rank path: P = diag(alpha) + V' diag(s) V with V: [T, n], T << n.
 
     ``alpha`` is a scalar (the backtest's shrinkage/jitter identity,
@@ -677,7 +953,26 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
     hoisting this [T, T] Gram across sweeps removes the one O(n T^2) term
     from the per-sweep setup. Passing the same product the solver would
     compute is a pure CSE-style hoist — bitwise-identical results.
+
+    ``anderson``: safeguarded Anderson-acceleration depth (0 — the default
+    — is bit-identical to the unaccelerated loop; see
+    :func:`_admm_iterations`). ``kernel``: ``"reference"`` (default) runs
+    the XLA iteration loop; ``"fused"`` runs each ``_ADAPT_EVERY``-iteration
+    segment as ONE Pallas dispatch (``ops/_pallas_admm.py``: x-step
+    solve-apply against the precomputed Woodbury inverse, relaxation,
+    soft-threshold z-step, dual update and residual accumulation in a
+    single on-chip loop over the VMEM-resident operands — interpret-mode on
+    CPU, compiled on TPU), collapsing the ~100 latency-bound matvec
+    dispatches per solve into one per segment. The adaptive-rho
+    refactorization, residual balancing, warm-start contract and exit
+    polish are IDENTICAL between kernels (shared code outside the loop);
+    only float reassociation inside the segment differs, pinned ≤ 1e-6 by
+    the differential fuzz. Problems wider than ``_FUSED_SEGMENT_MAX_N``
+    fall back to the reference loop at trace time (the operand set must
+    stay VMEM-resident).
     """
+    if kernel not in ("reference", "fused"):
+        raise ValueError(f"unknown solver kernel {kernel!r}")
     t, n = V.shape
     alpha = jnp.asarray(alpha)
     # mean(diag P) = mean(alpha) + sum_k s_k V_kj^2 / n
@@ -693,13 +988,16 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
     if not vector_alpha and vvt is None:
         vvt = V @ V.T                                # [T, T], factored once
 
-    def make_solver(rho):
+    def factor(rho):
         d = a + rho                                  # scalar or [n]
         # Woodbury: (D + V'SV)^-1 = D^-1 - D^-1 V'(S^-1 + V D^-1 V')^-1 V D^-1
         # Scalar d reuses the cached V V' (each adaptive-rho refactor is then
         # O(T^2 + T^3)); only vector d pays the O(n T^2) rebuild per refactor.
         vdv = (V / d) @ V.T if vector_alpha else vvt / d
-        inner_chol = jax.scipy.linalg.cho_factor(inv_ss + vdv)
+        return d, jax.scipy.linalg.cho_factor(inv_ss + vdv)
+
+    def make_solver(rho, factored=None):
+        d, inner_chol = factor(rho) if factored is None else factored
 
         def solve_m(r):
             # r is [n] or [n, K] (the equality columns E'); a vector d
@@ -730,8 +1028,46 @@ def admm_solve_lowrank(alpha: jnp.ndarray, V: jnp.ndarray, s: jnp.ndarray,
 
         return solve_m
 
+    fused_runner = None
+    if kernel == "fused" and n <= _FUSED_SEGMENT_MAX_N:
+        # lazy import: ops._pallas_admm pulls in pallas machinery that the
+        # reference path never needs
+        from factormodeling_tpu.ops import _pallas_admm as _pk
+
+        interpret = jax.default_backend() != "tpu"
+        collect = _obs_probes.collection_active()
+        eye_t = jnp.eye(t, dtype=V.dtype)
+
+        def fused_runner(z, u, rho, seg_len, last):
+            # per-segment refactor, OUTSIDE the kernel (O(T^3 + nTK), same
+            # work the reference path's factor() does — the kernel consumes
+            # explicit small inverses instead of Cholesky closures); the ONE
+            # factorization backs both solve_m and the kernel's kinv, so the
+            # 1e-6 differential pin rides a single matrix
+            dr, inner_chol = factor(rho)
+            solve_m = make_solver(rho, factored=(dr, inner_chol))
+            d = jnp.broadcast_to(dr, (n,))
+            kinv = jax.scipy.linalg.cho_solve(inner_chol, eye_t)  # [T, T]
+            minv_et = solve_m(prob.E.T)                           # [n, K]
+            g = prob.E @ minv_et
+            ginv = jax.scipy.linalg.cho_solve(
+                jax.scipy.linalg.cho_factor(g),
+                jnp.eye(g.shape[0], dtype=V.dtype))
+            ge = ginv @ prob.E                                    # [K, n]
+            xb = minv_et @ (ginv @ prob.b)                        # [n]
+            thresh = jnp.broadcast_to(
+                jnp.asarray(l1, V.dtype) / rho, (n,))
+            return _pk.admm_segment(
+                d, V, kinv, minv_et.T, ge, xb, q, prob.lo, prob.hi,
+                prob.center, thresh, z, u, rho,
+                relax=float(relax), seg_len=int(seg_len), last=bool(last),
+                anderson=int(anderson), collect=collect,
+                interpret=interpret)
+
     return _admm_iterations(make_solver, prob, q, l1, rho, iters, relax,
                             warm=warm_start,
                             polish_ops=(mv, masked_solver) if polish else None,
                             polish_passes=(_POLISH_PASSES if polish_passes
-                                           is None else int(polish_passes)))
+                                           is None else int(polish_passes)),
+                            anderson=int(anderson),
+                            fused_segment=fused_runner)

@@ -47,6 +47,21 @@ def test_default_targets_cover_examples_and_obs_layer():
     assert {"examples", "obs", "tools"} <= dirs
 
 
+def test_default_targets_cover_the_pallas_kernel_modules():
+    """Round 11 extends the surface over factormodeling_tpu/ops/_pallas_*.py:
+    a kernel file is where an ad-hoc interpret-vs-compiled micro-benchmark
+    window is most tempting to leave behind, and an unfenced one there times
+    the DISPATCH of a kernel whose whole point (the fused ADMM segment) is
+    dispatch-count reduction. Pinned by name so moving the kernels out of
+    ops/ can't silently drop them from the linted surface."""
+    targets = lint_timing.default_targets(REPO)
+    pallas = {p.name for p in targets if p.name.startswith("_pallas_")}
+    assert "_pallas_admm.py" in pallas          # the round-11 fused kernel
+    assert len(pallas) >= 3                     # + the rank/fused idioms
+    assert all(p.parent.name == "ops" for p in targets
+               if p.name.startswith("_pallas_"))
+
+
 def _lint_snippet(tmp_path, code):
     f = tmp_path / "snippet.py"
     f.write_text(textwrap.dedent(code))

@@ -284,6 +284,37 @@ def test_span_error_row_is_marked_unfenced():
     assert rep.rows[-1]["fenced"] is True
 
 
+def test_trace_report_solver_section_renders_anderson_counters():
+    """The round-11 solver section: Anderson accept/reset tallies render on
+    one row per source, whether they ride the research step's StageCounters
+    summary (flat keys) or a compat Simulation's nested "solver" dict — and
+    the section is absent entirely from pre-round-11 reports (no anderson
+    keys), so old JSONLs still render."""
+    import trace_report
+
+    flat = {"kind": "counters", "name": "research_step",
+            "counters": {"qp_solves": 60, "turnover_sweeps": 0,
+                         "turnover_suffix_len": 0,
+                         "anderson_accepted": 90, "anderson_rejected": 10}}
+    nested = {"kind": "counters", "name": "compat/sim/turnover",
+              "counters": {"solver": {"qp_solves": 27, "sweeps": 0,
+                                      "suffix_len": 0,
+                                      "anderson_accepted": 0,
+                                      "anderson_rejected": 0,
+                                      "anderson_accept_rate": float("nan")}}}
+    rendered = trace_report.render([flat, nested])
+    assert "== solver" in rendered
+    section = rendered.split("== solver")[1]
+    line = next(l for l in section.splitlines() if "research_step" in l)
+    assert "90" in line and "10" in line and "0.9000" in line
+    line = next(l for l in section.splitlines() if "compat/sim" in l)
+    assert "27" in line and "-" in line  # zero engagements -> rate "-"
+
+    old = {"kind": "counters", "name": "research_step",
+           "counters": {"qp_solves": 60}}
+    assert "== solver" not in trace_report.render([old])
+
+
 def test_counter_collection_overhead_is_small(rng):
     """Per-day counter collection rides reductions over arrays the step
     already materializes; measured overhead is within run-to-run noise

@@ -200,3 +200,135 @@ else:
     @pytest.mark.parametrize("seed", SEED_SWEEP)
     def test_fuzz_admm_matches_osqp_reference(seed):
         check_instance(seed)
+
+
+# ---------------------------------------------------------------- round 11:
+# the Anderson-acceleration axis and the fused Pallas segment kernel.
+# Acceleration changes the PATH to the optimum, never the optimum (the
+# safeguard falls back to plain ADMM steps and the final iterations are
+# always plain — solvers/admm_qp.py); the fused kernel reassociates floats
+# inside a segment but must match the reference loop to 1e-6.
+
+
+def draw_hard_instance(seed):
+    """Adversarial variants for the Anderson safeguard: near-degenerate P
+    (tiny alpha — the quadratic is ~singular along V-orthogonal directions,
+    the regime where unsafeguarded mixing wanders the near-flat manifold)
+    and tight boxes (width ~1e-3-5e-2, so naive extrapolation constantly
+    violates feasibility and the prox clips hard every iteration)."""
+    rng = np.random.default_rng(seed + 7777)
+    inst = draw_instance(seed)
+    n = inst["n"]
+    alpha = float(rng.uniform(1e-10, 1e-7))          # near-degenerate
+    inst["alpha"] = alpha
+    inst["P"] = alpha * np.eye(n) + inst["V"].T @ (
+        inst["s"][:, None] * inst["V"])
+    width = rng.uniform(1e-3, 5e-2, size=n)          # tight boxes
+    lo = rng.uniform(-0.5, 0.4, size=n)
+    hi = lo + width
+    pin = rng.uniform(size=n) < 0.2
+    hi[pin] = lo[pin]
+    x0 = rng.uniform(lo, hi)
+    inst.update(lo=lo, hi=hi, b=inst["E"] @ x0)
+    inst["l1"] = float(rng.uniform(0.1, 2.0))        # L1 always on, heavy
+    # centers frequently OUTSIDE the tight box (yesterday's weight past
+    # today's cap — the common turnover case the polish docstring documents)
+    inst["center"] = rng.uniform(lo - 0.1, hi + 0.1)
+    return inst
+
+
+def admm_anderson_solutions(inst, iters):
+    prob = BoxQPProblem(jnp.asarray(inst["q"]), jnp.asarray(inst["lo"]),
+                        jnp.asarray(inst["hi"]), jnp.asarray(inst["E"]),
+                        jnp.asarray(inst["b"]), jnp.asarray(inst["l1"]),
+                        jnp.asarray(inst["center"]))
+    lr = admm_solve_lowrank(jnp.asarray(inst["alpha"]),
+                            jnp.asarray(inst["V"]), jnp.asarray(inst["s"]),
+                            prob, iters=iters, anderson=5)
+    dn = admm_solve_dense(jnp.asarray(inst["P"]), prob, iters=iters,
+                          anderson=5)
+    fused = admm_solve_lowrank(jnp.asarray(inst["alpha"]),
+                               jnp.asarray(inst["V"]), jnp.asarray(inst["s"]),
+                               prob, iters=iters, anderson=5, kernel="fused")
+    fused_plain = admm_solve_lowrank(
+        jnp.asarray(inst["alpha"]), jnp.asarray(inst["V"]),
+        jnp.asarray(inst["s"]), prob, iters=iters, kernel="fused")
+    ref_plain = admm_solve_lowrank(
+        jnp.asarray(inst["alpha"]), jnp.asarray(inst["V"]),
+        jnp.asarray(inst["s"]), prob, iters=iters)
+    return lr, dn, fused, fused_plain, ref_plain
+
+
+def check_anderson_instance(inst, *, feas_tol=5e-2, obj_tol=1e-3,
+                            aa_path_stable=True):
+    """The Anderson-on contract at the default-ish cold budget: the
+    safeguarded accelerated solve must stay inside the SAME acceptance
+    tier as the unaccelerated one (tier 2: feasibility + objective vs the
+    OSQP-algorithm oracle), on every instance including the adversarial
+    ones — the safeguard, not luck, is what keeps the L1 kink and the box
+    projections from destabilizing the mixing. The fused kernel must
+    match the reference loop to 1e-6 on x with acceleration off (float
+    reassociation only — same iteration schedule) and, on well-posed
+    instances, with acceleration on too (same safeguard decisions).
+
+    ``aa_path_stable=False`` relaxes ONLY the accelerated differential to
+    the oracle-tier check: on near-degenerate instances a 1-ulp
+    reassociation difference between kernels can flip a safeguard
+    accept/reject (the tallies are published and measurably differ), and
+    on a kink-dominated near-flat objective the two accepted PATHS exit
+    ~1e-3 apart — both at the same solution grade. Bit-tracking a
+    threshold decision chain through a chaotic region is not a contract
+    either kernel makes; the solution tier is."""
+    x_ref = osqp_reference_solution(inst)
+    f_ref = objective(inst, x_ref)
+    scale = 1.0 + abs(f_ref)
+
+    lr, dn, fused, fused_plain, ref_plain = admm_anderson_solutions(
+        inst, iters=80)
+    for res in (lr, dn):
+        assert np.all(np.isfinite(np.asarray(res.x)))
+        assert feasibility(inst, res.x) < feas_tol
+        assert objective(inst, res.x) <= f_ref + obj_tol * scale, (
+            objective(inst, res.x), f_ref)
+
+    # fused-vs-reference differential (interpret mode on CPU): <= 1e-6
+    np.testing.assert_allclose(np.asarray(fused_plain.x),
+                               np.asarray(ref_plain.x), atol=1e-6)
+    if aa_path_stable:
+        np.testing.assert_allclose(np.asarray(fused.x), np.asarray(lr.x),
+                                   atol=1e-6)
+    else:
+        assert np.all(np.isfinite(np.asarray(fused.x)))
+        assert feasibility(inst, fused.x) < feas_tol
+        assert objective(inst, fused.x) <= f_ref + obj_tol * scale, (
+            objective(inst, fused.x), f_ref)
+    # the safeguard telemetry must be consistent: the accelerated solve
+    # reports its accept/reset tallies, the plain one reports zeros
+    assert int(ref_plain.aa_accepted) == 0 and int(ref_plain.aa_rejected) == 0
+    assert int(lr.aa_accepted) >= 0 and int(lr.aa_rejected) >= 0
+
+
+@pytest.mark.parametrize("seed", SEED_SWEEP)
+def test_fuzz_anderson_matches_osqp_reference(seed):
+    check_anderson_instance(draw_instance(seed))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_anderson_hard_instances(seed):
+    """Near-degenerate P + tight boxes: the cases where naive Anderson
+    mixing violates feasibility. The L1 term here is heavy relative to the
+    tiny quadratic, so the objective is kink-dominated and the active-set
+    polish cannot always fully identify — the PLAIN solver itself lands at
+    the few-1e-3 grade on these (measured -2.4e-3 at 1.9e-3 infeasibility
+    on seed 1), so the oracle comparison uses the documented tier-3 band
+    (2e-2); the point of the test is that the SAFEGUARDED accelerated
+    solve stays in that band too (naive growth-only safeguarding left
+    exits at the 1e-1 grade). The plain fused kernel still tracks the
+    reference bit-tightly here (measured <= 1.4e-15 across the six
+    seeds); the ACCELERATED differential drops to the oracle-tier check
+    (``aa_path_stable=False``) because near-singular instances flip
+    safeguard decisions between kernels at the ulp level — seed 1's
+    kernels accept 22 vs 20 extrapolations and exit 1.3e-3 apart, same
+    tier."""
+    check_anderson_instance(draw_hard_instance(seed), obj_tol=2e-2,
+                            aa_path_stable=False)

@@ -171,6 +171,81 @@ def test_unrolled_segment_path_matches_rolled(rng, monkeypatch):
                                       float(unrolled.primal_residual))
 
 
+def test_unroll_env_override(rng, monkeypatch):
+    """``FMT_ADMM_UNROLL`` contract (round 11): a positive integer forces
+    that unroll on ANY backend (here: opting CPU into the unrolled segment
+    schedule, exact-equal to the rolled path); unparseable or non-positive
+    values are ignored; and the FUSED kernel path ignores the knob entirely
+    — unroll is meaningless inside a Pallas program — so its output is
+    byte-identical under any override."""
+    from factormodeling_tpu.solvers import admm_qp
+
+    # resolution rules, read at trace time like the backend probe
+    monkeypatch.setenv("FMT_ADMM_UNROLL", "4")
+    assert admm_qp._unroll_factor() == 4
+    monkeypatch.setenv("FMT_ADMM_UNROLL", "garbage")
+    assert admm_qp._unroll_factor() == 1   # CPU default: rolled
+    monkeypatch.setenv("FMT_ADMM_UNROLL", "-3")
+    assert admm_qp._unroll_factor() == 1
+    monkeypatch.setenv("FMT_ADMM_UNROLL", "0")
+    assert admm_qp._unroll_factor() == 1
+    monkeypatch.delenv("FMT_ADMM_UNROLL")
+    assert admm_qp._unroll_factor() == 1
+
+    n, t = 24, 12
+    V = jnp.asarray(rng.normal(scale=0.02, size=(t, n)))
+    sig = rng.normal(size=n)
+    pos, neg = sig > 0, sig < 0
+    prob = BoxQPProblem(
+        jnp.zeros(n), jnp.asarray(np.where(neg, -0.3, 0.0)),
+        jnp.asarray(np.where(pos, 0.3, 0.0)),
+        jnp.asarray(np.stack([pos.astype(float), neg.astype(float)])),
+        jnp.asarray([1.0, -1.0]), jnp.asarray(0.05),
+        jnp.zeros(n))
+    args = (jnp.asarray(1e-4), V, jnp.full(t, 1e-3), prob)
+
+    # forced unroll == rolled, exactly (same ops, different schedule)
+    base = admm_solve_lowrank(*args, iters=60)
+    monkeypatch.setenv("FMT_ADMM_UNROLL", "4")
+    forced = admm_solve_lowrank(*args, iters=60)
+    np.testing.assert_array_equal(np.asarray(base.x), np.asarray(forced.x))
+
+    # fused path: byte-identical with and without the override
+    fused_forced = admm_solve_lowrank(*args, iters=60, kernel="fused")
+    monkeypatch.delenv("FMT_ADMM_UNROLL")
+    fused_plain = admm_solve_lowrank(*args, iters=60, kernel="fused")
+    np.testing.assert_array_equal(np.asarray(fused_forced.x),
+                                  np.asarray(fused_plain.x))
+    np.testing.assert_array_equal(np.asarray(fused_forced.z),
+                                  np.asarray(fused_plain.z))
+
+
+def test_fused_kernel_honors_wide_equality_systems(rng):
+    """K > 8 equality rows through the fused kernel (round 11 regression):
+    the equality operators block to their own padded row count, so every
+    row enters the correction contraction. A hard-coded 8-sublane block
+    silently read only the first 8 rows — max equality violation ~3 with
+    no error. The backtest's K=2 never sees this; the public solver API
+    does."""
+    n, t, K = 40, 12, 9
+    V = jnp.asarray(rng.normal(size=(t, n)))
+    E = jnp.asarray(rng.normal(size=(K, n)))
+    b = jnp.asarray(rng.normal(size=K) * 0.1)
+    prob = BoxQPProblem(jnp.asarray(rng.normal(size=n) * 0.01),
+                        jnp.full(n, -0.3), jnp.full(n, 0.3),
+                        E, b, jnp.asarray(0.01), jnp.zeros(n))
+    args = (jnp.asarray(0.5), V, jnp.full(t, 1.0 / t), prob)
+    ref = admm_solve_lowrank(*args, iters=200, polish=False,
+                             kernel="reference")
+    fused = admm_solve_lowrank(*args, iters=200, polish=False,
+                               kernel="fused")
+    # all K rows satisfied, and the iterates track the reference
+    viol = np.abs(np.asarray(E) @ np.asarray(fused.x) - np.asarray(b))
+    assert viol.max() < 1e-8
+    np.testing.assert_allclose(np.asarray(fused.x), np.asarray(ref.x),
+                               atol=1e-6)
+
+
 def test_spd_solve_matches_numpy_and_propagates_nan(rng):
     """The custom-call-free batched Gauss-Jordan solve (ops/_linalg) must
     match numpy on well-conditioned SPD batches and propagate NaN on

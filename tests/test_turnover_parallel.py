@@ -135,6 +135,26 @@ def test_parallel_matches_scan_across_ladder(rng, case):
                                atol=1e-6, equal_nan=True)
 
 
+def test_parallel_with_fused_kernel_runs_at_divisible_batch(rng):
+    """solver_kernel="fused" + turnover_mode="parallel" at d % mvo_batch == 0.
+
+    Regression: the parallel lanes ride lax.map, whose zero-size remainder
+    chunk (jax 0.4.x emits one even when the batch divides d) fails to
+    lower a vmapped pallas_call — the lanes therefore pin the reference
+    kernel (see _mvo_turnover_parallel) and only the sequential suffix
+    honors the knob. The combination must trace, run, and agree with the
+    scan to the ladder-matrix bar."""
+    assert D % 8 == 0  # the shape that used to crash at trace time
+    returns, cap, invest, signal = make_market(rng)
+    out_scan, out_par = run_pair(signal, returns, cap, invest,
+                                 solver_kernel="fused", **_TIGHT)
+    w_s = np.nan_to_num(np.asarray(out_scan.weights))
+    w_p = np.nan_to_num(np.asarray(out_par.weights))
+    assert np.abs(w_p - w_s).max() <= 1e-5
+    np.testing.assert_array_equal(np.asarray(out_par.diagnostics.solver_ok),
+                                  np.asarray(out_scan.diagnostics.solver_ok))
+
+
 def test_scan_mode_is_default_and_reports_sequential_stats(rng):
     returns, cap, invest, signal = make_market(rng)
     s = settings_for(returns, cap, invest, max_weight=0.5, lookback_period=6,

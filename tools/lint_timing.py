@@ -216,7 +216,12 @@ def default_targets(repo_root=None) -> list[Path]:
     with the compile-telemetry round (round 9): the obs layer is where
     wall-clock windows are MADE (``obs.span``'s fence-inside-the-window
     discipline must hold in its own source), and the examples are the
-    copy-paste surface users time their own runs from — both stay under
+    copy-paste surface users time their own runs from. The ops Pallas
+    kernel modules joined with the fused ADMM segment kernel (round 11):
+    a kernel file is where an ad-hoc interpret-vs-compiled
+    micro-benchmark window is most tempting to leave behind, and an
+    unfenced one there times the DISPATCH of a kernel whose whole point
+    is dispatch-count reduction — both stay under
     rule A permanently."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
@@ -224,6 +229,7 @@ def default_targets(repo_root=None) -> list[Path]:
             + sorted((root / "examples").glob("*.py"))
             + sorted((pkg / "backtest").glob("*.py"))
             + sorted((pkg / "obs").glob("*.py"))
+            + sorted((pkg / "ops").glob("_pallas_*.py"))
             + sorted((pkg / "solvers").glob("*.py")))
 
 

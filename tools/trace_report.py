@@ -5,9 +5,10 @@ Usage::
     python tools/trace_report.py run_report.jsonl [more.jsonl ...]
 
 Spans aggregate by name (count / total / mean / max wall seconds, whether
-they fenced); counters, numerics probes, compile telemetry, the placement
-ledger (comms / device memory / sharding lint), cost-analysis estimates,
-bench rows, and plain stage records print in their own sections. Pure
+they fenced); counters, the solver section (scheme + Anderson-acceleration
+telemetry), numerics probes, compile telemetry, the placement ledger
+(comms / device memory / sharding lint), cost-analysis estimates, bench
+rows, and plain stage records print in their own sections. Pure
 stdlib — usable on any box that has the JSONL, no jax required.
 """
 
@@ -133,6 +134,51 @@ def _num(v):
     if isinstance(v, float):
         return f"{v:.4g}"
     return v
+
+
+def _solver_table(rows) -> str | None:
+    """Dedicated solver section: the scheme counters (qp_solves, the
+    turnover-parallel sweep/suffix telemetry) and the round-11 Anderson
+    accept/reset tallies, pulled from wherever a report carries them — the
+    research step's ``StageCounters`` summary (flat keys) or a compat
+    Simulation's nested ``"solver"`` dict. The generic device-counters
+    table still lists every field; this section puts the solver story on
+    one row per source so "did acceleration engage, and did the safeguard
+    carry it" is readable without scanning the full counter dump."""
+    body = []
+    for r in rows:
+        if r.get("kind") != "counters":
+            continue
+        c = r.get("counters") or {}
+        nested = c.get("solver") if isinstance(c.get("solver"), dict) else {}
+        flat = nested or c
+        if "anderson_accepted" not in flat:
+            continue
+
+        def g(*keys):
+            for k in keys:
+                if k in flat:
+                    return flat[k]
+            return "-"
+
+        acc, rej = flat["anderson_accepted"], flat.get("anderson_rejected", 0)
+        try:
+            total = int(acc) + int(rej)
+            rate = f"{int(acc) / total:.4f}" if total else "-"
+        except (TypeError, ValueError):
+            rate = "-"
+        body.append((r.get("name", "?"),
+                     g("qp_solves"),
+                     g("sweeps", "turnover_sweeps"),
+                     g("suffix_len", "turnover_suffix_len"),
+                     acc, rej, rate))
+    if not body:
+        return None
+    return ("== solver (scheme + anderson-acceleration telemetry; rejected "
+            "= safeguard resets) ==\n"
+            + _fmt_table(("source", "qp_solves", "sweeps", "suffix_len",
+                          "aa_accepted", "aa_rejected", "aa_accept_rate"),
+                         body))
 
 
 def _cost_table(rows) -> str | None:
@@ -310,7 +356,8 @@ def render(rows) -> str:
             ("schema_version", "jax_version", "backend", "device_kind",
              "device_count", "mesh_shape") if meta.get(k) is not None))
     sections = [head]
-    for maker in (_span_table, _counter_table, _numerics_table,
+    for maker in (_span_table, _counter_table, _solver_table,
+                  _numerics_table,
                   _watchdog_table, _compile_table, _comms_table,
                   _memory_table, _sharding_table, _cost_table,
                   _bench_table, _stage_table):
