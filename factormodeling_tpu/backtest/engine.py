@@ -37,6 +37,11 @@ class SimulationOutput(NamedTuple):
     short_count: jnp.ndarray   # [D]
     result: DailyResult
     diagnostics: SolverDiagnostics
+    # resil.policy.HoldStats when the settings carry a DegradePolicy, else
+    # None — a None leaf is structurally absent, so the no-policy engine's
+    # HLO and outputs are bit-identical to a build without the resil layer
+    # (the StageCounters elision contract, extended to degradation).
+    degrade: "object | None" = None
 
 
 def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
@@ -46,6 +51,17 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
     Returns ``(weights, long_count, short_count, diagnostics)``; the
     :class:`SolverDiagnostics` carry the ADMM residual/acceptance for the QP
     schemes and the pre-shift leg sums for all four."""
+    shifted, lc, sc, diag, _ = _trade_list_and_degrade(signal, s)
+    return shifted, lc, sc, diag
+
+
+def _trade_list_and_degrade(signal: jnp.ndarray, s: SimulationSettings):
+    """:func:`daily_trade_list` plus the degradation tallies: when the
+    settings carry a ``resil.DegradePolicy``, the pre-shift weights pass
+    through the policy's hold pass (min-universe hold / solver-fallback
+    carry — ``resil.policy.hold_weights``) before shifting, and the fifth
+    return is its :class:`~factormodeling_tpu.resil.policy.HoldStats`
+    (None without a policy — nothing extra is traced)."""
     d = signal.shape[0]
     nan_d = jnp.full((d,), jnp.nan, signal.dtype)
     ok_d = jnp.ones((d,), bool)
@@ -65,6 +81,18 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
         else:  # mvo_turnover
             w, lc, sc, resid, ok, polish, stats = mvo_turnover_weights(signal, s)
 
+    hold_stats = None
+    if s.degrade is not None:
+        from factormodeling_tpu.resil import policy as resil_policy
+
+        if s.universe is not None:
+            uni_count = s.universe.sum(-1)
+        else:
+            uni_count = jnp.full((d,), signal.shape[-1])
+        with obs_stage("resil/hold"):
+            w, lc, sc, hold_stats = resil_policy.hold_weights(
+                w, lc, sc, ok, uni_count, s.degrade)
+
     diag = SolverDiagnostics(
         primal_residual=resid, solver_ok=ok,
         long_sum=jnp.maximum(w, 0.0).sum(-1),
@@ -81,7 +109,7 @@ def daily_trade_list(signal: jnp.ndarray, s: SimulationSettings):
         shifted = masked_shift(w, s.universe, 1, axis=0)
     else:
         shifted = shift(w, 1, axis=0)
-    return shifted, lc, sc, diag
+    return shifted, lc, sc, diag, hold_stats
 
 
 def run_simulation(signal: jnp.ndarray, s: SimulationSettings) -> SimulationOutput:
@@ -89,8 +117,9 @@ def run_simulation(signal: jnp.ndarray, s: SimulationSettings) -> SimulationOutp
     ``Simulation.run`` minus host-side printing/plotting, which live in
     :mod:`factormodeling_tpu.analytics`)."""
     masked = signal * s.investability_flag
-    weights, lc, sc, diag = daily_trade_list(masked, s)
+    weights, lc, sc, diag, hold_stats = _trade_list_and_degrade(masked, s)
     with obs_stage("backtest/pnl"):
         result = daily_portfolio_returns(weights, s)
     return SimulationOutput(weights=weights, long_count=lc, short_count=sc,
-                            result=result, diagnostics=diag)
+                            result=result, diagnostics=diag,
+                            degrade=hold_stats)

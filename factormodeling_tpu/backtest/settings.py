@@ -32,12 +32,25 @@ class SimulationSettings:
     investability_flag: jnp.ndarray   # float[D, N] 0/1 (NaN allowed)
     universe: jnp.ndarray | None = None  # bool[D, N] long-index membership
 
+    # optional degradation policy (factormodeling_tpu.resil.policy
+    # .DegradePolicy — typed loosely to keep this module import-light): a
+    # traced pytree of guard thresholds the engine applies as a pre-shift
+    # hold pass (min-universe hold, solver-fallback carry). None (the
+    # default) traces NO policy subgraph — the engine's HLO is identical
+    # to a build without the resil layer — and the default
+    # DegradePolicy.make() is bit-inert (all-False masks select the
+    # original weights exactly); see docs/architecture.md section 18.
+    degrade: "object | None" = None
+
     # simulation parameters
     method: str = dataclasses.field(default="equal", metadata=dict(static=True))
     transaction_cost: bool = dataclasses.field(default=True, metadata=dict(static=True))
     max_weight: float = 0.03
     pct: float = 0.1
-    min_universe: int = 1000          # parity only; unused (see module docstring)
+    # parity only; unused (see module docstring). NOT the round-12
+    # min-universe hold guard — that is DegradePolicy.min_universe,
+    # wired through the `degrade` field above; setting THIS does nothing
+    min_universe: int = 1000
     # parity only: the reference gates its contributor printout on this
     # (portfolio_simulation.py:792-795); DailyResult always carries the
     # per-name P&L columns, so there is nothing to switch on-device

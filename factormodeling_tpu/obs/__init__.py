@@ -86,6 +86,7 @@ from factormodeling_tpu.obs.probes import (  # noqa: F401
     ProbeFrame,
     enable_probes,
     probe,
+    probe_profile,
     probes_enabled,
     probing,
     summarize_probes,

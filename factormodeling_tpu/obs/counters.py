@@ -88,6 +88,15 @@ class StageCounters(NamedTuple):
       over the run's ADMM solves (0 with ``qp_anderson=0``; a high reject
       share means the safeguard carried the solve — see
       ``backtest.diagnostics.SolverDiagnostics``).
+    quarantined_days / held_days / carry_fallback_days / clamped_cells /
+      degrade_events: ``int32[]`` — the degradation-policy tallies
+      (``resil.policy.DegradeStats``): dates masked out of the rolling
+      windows, dates whose book held on the min-universe guard, dates
+      carried on a solver fallback, signal cells clamped, and their
+      date-level sum. All 0 when no :class:`DegradePolicy` is wired (the
+      default) — and ``report_diff`` gates UP on ``degrade_events``: a
+      healthy feed degrades nowhere, so growth against a baseline report
+      is a regression.
     """
 
     universe_size: jnp.ndarray
@@ -106,10 +115,15 @@ class StageCounters(NamedTuple):
     turnover_suffix_len: jnp.ndarray
     anderson_accepted: jnp.ndarray
     anderson_rejected: jnp.ndarray
+    quarantined_days: jnp.ndarray
+    held_days: jnp.ndarray
+    carry_fallback_days: jnp.ndarray
+    clamped_cells: jnp.ndarray
+    degrade_events: jnp.ndarray
 
 
 def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
-                   sim) -> StageCounters:
+                   sim, degrade=None) -> StageCounters:
     """Collect the pytree from the research step's own intermediates
     (traceable; call inside the jitted step).
 
@@ -118,6 +132,9 @@ def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
       universe: ``bool[D, N]`` mask or None.
       selection: ``float[D, F]`` normalized daily factor weights.
       sim: the engine's ``SimulationOutput`` (diagnostics + leg counts).
+      degrade: optional ``resil.policy.DegradeStats`` (duck-typed to keep
+        this module import-light) — the degradation-policy tallies; None
+        (no policy wired) reports zeros.
     """
     f, d, n = factors.shape
     if universe is not None:
@@ -138,6 +155,7 @@ def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
     delta = selection - jnp.roll(selection, 1, axis=0)
     churn = 0.5 * jnp.abs(delta).sum(-1)
     churn = jnp.where(jnp.arange(d) == 0, 0.0, churn)
+    zero_i = jnp.zeros((), jnp.int32)
     return StageCounters(
         universe_size=uni_size,
         factor_nan_frac=nan_cnt.astype(factors.dtype) / tot,
@@ -159,6 +177,14 @@ def stage_counters(factors: jnp.ndarray, universe, selection: jnp.ndarray,
             diag.anderson_accepted).sum().astype(jnp.int32),
         anderson_rejected=jnp.asarray(
             diag.anderson_rejected).sum().astype(jnp.int32),
+        quarantined_days=(zero_i if degrade is None
+                          else degrade.quarantined_days),
+        held_days=zero_i if degrade is None else degrade.held_days,
+        carry_fallback_days=(zero_i if degrade is None
+                             else degrade.carry_days),
+        clamped_cells=zero_i if degrade is None else degrade.clamped_cells,
+        degrade_events=(zero_i if degrade is None
+                        else degrade.degrade_events),
     )
 
 

@@ -52,8 +52,8 @@ import numpy as np
 from jax import lax
 
 __all__ = ["ProbeFrame", "capture", "collection_active", "enable_probes",
-           "probe", "probing", "probes_enabled", "summarize_frame",
-           "summarize_probes", "watchdog"]
+           "probe", "probe_profile", "probing", "probes_enabled",
+           "summarize_frame", "summarize_probes", "watchdog"]
 
 _ENABLED = False
 _ACTIVE: "ProbeCapture | None" = None
@@ -277,10 +277,33 @@ def summarize_probes(frames: dict) -> dict:
     return dict(items)
 
 
+def probe_profile(frames: dict, *, absmax_stages=(),
+                  nonzero_stages=()) -> dict:
+    """A clean run's per-stage baseline for :func:`watchdog`'s extended
+    checks: every stage contributes its ``finite_frac``; stages named in
+    ``absmax_stages`` additionally pin their ``absmax`` (catches
+    outlier-class corruption, which leaves the finite fraction intact) and
+    stages in ``nonzero_stages`` pin their finite-nonzero cell count (the
+    ``log2_hist`` total — catches stale/duplicated-date corruption, which
+    moves NEITHER finite fraction nor absmax; the faulted research step's
+    ``ops/factors_delta`` canary exists exactly for this check)."""
+    summaries = {k: (v if isinstance(v, dict) else summarize_frame(v))
+                 for k, v in frames.items()}
+    profile = {}
+    for name, s in summaries.items():
+        entry: dict = {"finite_frac": s["finite_frac"]}
+        if name in absmax_stages:
+            entry["absmax"] = s["absmax"]
+        if name in nonzero_stages:
+            entry["nonzero"] = int(sum(s["log2_hist"]))
+        profile[name] = entry
+    return profile
+
+
 def watchdog(frames: dict, baseline: dict | None = None,
-             tol: float = 1e-6) -> dict:
-    """Pinpoint the FIRST stage (by trace order) whose finite fraction
-    dropped.
+             tol: float = 1e-6, absmax_ratio: float = 100.0,
+             nonzero_tol: int = 0) -> dict:
+    """Pinpoint the FIRST stage (by trace order) whose summary degraded.
 
     Args:
       frames: ``{name: ProbeFrame}`` (or already-summarized dicts from
@@ -295,6 +318,16 @@ def watchdog(frames: dict, baseline: dict | None = None,
         the likeliest NaN source — fall back to their absolute
         ``expect_finite`` check rather than passing silently.
 
+        A baseline VALUE may also be a dict (:func:`probe_profile` builds
+        one): ``finite_frac`` keeps the drop check; an ``absmax`` key adds
+        a blowup check (bad when the stage's absmax exceeds
+        ``absmax_ratio`` x baseline — outlier-class corruption is finite,
+        so the fraction check alone cannot see it); a ``nonzero`` key adds
+        a finite-nonzero-count drop check beyond ``nonzero_tol`` cells
+        (stale-date corruption zeroes day-over-day deltas without moving
+        fraction or absmax). Keys absent from a stage's dict leave that
+        check off — plain-float baselines behave exactly as before.
+
     Returns a JSON-ready dict: ``first_bad_stage`` (None when clean),
     ``dropped`` (every offending stage in order), and the per-stage
     ``finite_frac`` map the verdict was computed from.
@@ -307,7 +340,18 @@ def watchdog(frames: dict, baseline: dict | None = None,
     for name, s in ordered:
         frac = float(s["finite_frac"])
         if baseline is not None and name in baseline:
-            if frac < float(baseline[name]) - tol:
+            base = baseline[name]
+            if not isinstance(base, dict):
+                base = {"finite_frac": base}
+            bad = (base.get("finite_frac") is not None
+                   and frac < float(base["finite_frac"]) - tol)
+            if not bad and base.get("absmax") is not None:
+                floor = max(float(base["absmax"]), 1e-12)
+                bad = float(s["absmax"]) > floor * absmax_ratio
+            if not bad and base.get("nonzero") is not None:
+                nz = int(sum(s["log2_hist"]))
+                bad = nz < int(base["nonzero"]) - int(nonzero_tol)
+            if bad:
                 dropped.append(name)
         else:
             # no baseline, or a stage the baseline has never seen: judge

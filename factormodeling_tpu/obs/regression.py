@@ -62,8 +62,11 @@ __all__ = ["DiffResult", "Finding", "GATE_UP", "comms_rows",
 
 #: counter keys whose INCREASE is a regression (everything else drifts
 #: informationally). Nested mean/max counters gate on their "mean" leaf.
+#: ``degrade_events`` (resil.policy.DegradeStats): a healthy feed degrades
+#: nowhere, so a baseline-relative growth of quarantined/held/carried/
+#: clamped dates means the inputs (or the solver) got worse.
 GATE_UP = ("solver_fallback_days", "factor_nan_frac", "retraces",
-           "turnover_suffix_len")
+           "turnover_suffix_len", "degrade_events")
 
 
 @dataclasses.dataclass
@@ -109,7 +112,13 @@ def load_jsonl(path) -> list:
     — same contract as ``tools/trace_report.py``."""
     rows = []
     path = Path(path)
-    with path.open() as fh:
+    # errors="replace": undecodable bytes (a binary file passed by
+    # mistake, a torn multi-byte char at a truncation point) become
+    # replacement chars that fail json.loads and take the skip-with-
+    # warning path below — never a UnicodeDecodeError traceback, which
+    # would escape the callers' OSError handling and exit with the wrong
+    # code (tools/report_diff.py's exit-code contract)
+    with path.open(errors="replace") as fh:
         for lineno, line in enumerate(fh, start=1):
             line = line.strip()
             if not line:

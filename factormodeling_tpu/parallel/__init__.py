@@ -40,6 +40,7 @@ from factormodeling_tpu.parallel.streaming import (  # noqa: F401
     clear_streaming_cache,
     chunk_sharding,
     host_array_source,
+    set_kernel_cache_size,
     streamed_factor_stats,
     streamed_linear_research,
     streamed_weighted_composite,
@@ -47,6 +48,7 @@ from factormodeling_tpu.parallel.streaming import (  # noqa: F401
 )
 from factormodeling_tpu.parallel.sweep import (  # noqa: F401
     SweepOutput,
+    checkpointed_manager_sweep,
     combo_weight_matrix,
     manager_sweep,
     make_sharded_manager_sweep,

@@ -101,9 +101,12 @@ def build_research_step(*, names, window: int,
                         blend_method: str = "zscore",
                         sim_kwargs: dict[str, Any] | None = None,
                         collect_counters: bool | None = None,
-                        collect_probes: bool | None = None):
+                        collect_probes: bool | None = None,
+                        fault_spec=None, policy=None,
+                        probe_canary: bool | None = None):
     """Close the static config over a jittable
-    ``step(factors, returns, factor_ret, cap_flag, investability, universe)``.
+    ``step(factors, returns, factor_ret, cap_flag, investability, universe,
+    fault_spec=None, policy=None)``.
 
     Args (of the returned step):
       factors: ``float[F, D, N]`` raw exposures, order matching ``names``.
@@ -111,6 +114,32 @@ def build_research_step(*, names, window: int,
       factor_ret: ``float[D, F]`` precomputed per-date factor returns.
       cap_flag / investability: ``[D, N]`` panels.
       universe: ``bool[D, N]`` membership mask.
+      fault_spec / policy: optional
+        :class:`~factormodeling_tpu.resil.faults.FaultSpec` /
+        :class:`~factormodeling_tpu.resil.policy.DegradePolicy` pytrees
+        (the build-time kwargs of the same names set call-time defaults).
+        Presence is decided at TRACE time: with both None — the default —
+        NO resilience subgraph is traced and the step's HLO is
+        byte-identical to a build without the resil layer (pinned in
+        ``tests/test_resil.py``). When given, every field is a traced
+        leaf, so one compiled step serves a whole chaos matrix of specs
+        and policies (``tools/chaos.py``); the default
+        ``DegradePolicy.make()`` and the zero-rate ``FaultSpec.off()``
+        reproduce the clean outputs bit-identically through that same
+        executable. Faults inject at the stage boundaries BEFORE the
+        stage's probe (the watchdog must see the corruption); the policy's
+        signal clamp applies AFTER the blend probe (the probe observes the
+        stage's raw product, the clamp is the response to it). A faulted
+        build with probes on additionally probes the ``ops/factors_delta``
+        staleness canary (:func:`~factormodeling_tpu.resil.faults.
+        staleness_canary`).
+      probe_canary: the staleness canary's own gate, for PRODUCTION
+        monitoring: a real stale feed moves neither finite fraction nor
+        absmax, so a clean probed step cannot see one without the canary
+        — ``probe_canary=True`` adds it (probes on) WITHOUT tracing the
+        6-class injection subgraph a ``FaultSpec.off()`` would drag in.
+        Default None follows fault-spec presence (the chaos-harness
+        behavior above); False suppresses it even for faulted builds.
 
     ``collect_counters`` gates device-side
     :class:`~factormodeling_tpu.obs.counters.StageCounters` collection in
@@ -134,41 +163,89 @@ def build_research_step(*, names, window: int,
         collect_counters = obs_counters.counters_enabled()
     if collect_probes is None:
         collect_probes = obs_probes.probes_enabled()
+    default_fault, default_policy = fault_spec, policy
 
     def step(factors, returns, factor_ret, cap_flag, investability,
-             universe) -> ResearchOutput:
+             universe, fault_spec=None, policy=None) -> ResearchOutput:
+        fault_spec = default_fault if fault_spec is None else fault_spec
+        policy = default_policy if policy is None else policy
+        canary = (fault_spec is not None if probe_canary is None
+                  else bool(probe_canary))
+        if fault_spec is not None or policy is not None or canary:
+            from factormodeling_tpu.resil import faults as resil_faults
+            from factormodeling_tpu.resil import policy as resil_policy
         # the capture is (re)entered on every trace of the step, so probes
         # survive retraces and fresh jits; with probes off the nullcontext
         # leaves obs_probes.probe as an identity and nothing is traced
         cap_ctx = (obs_probes.capture() if collect_probes
                    else contextlib.nullcontext())
         with cap_ctx as cap:
+            if fault_spec is not None:
+                with obs_stage("resil/faults"):
+                    factors = resil_faults.inject("ops/factors_raw", factors,
+                                                  fault_spec, date_axis=1)
+                    universe = resil_faults.inject_universe(universe,
+                                                            fault_spec)
             if collect_probes:
                 # raw panels legitimately carry NaN (expect_finite=None):
                 # only a baseline-relative watchdog judges their NaN share
                 obs_probes.probe("ops/factors_raw", factors,
                                  expect_finite=None)
+                if canary:
+                    # staleness canary: stale/duplicated-date faults move
+                    # neither finite fraction nor absmax — only the
+                    # day-over-day delta's nonzero count can see them
+                    # (watchdog's nonzero check, resil/faults.py docs)
+                    obs_probes.probe(
+                        "ops/factors_delta",
+                        resil_faults.staleness_canary(factors),
+                        expect_finite=None)
+            qday = None
+            sel_factors, sel_fr = factors, factor_ret
+            if policy is not None:
+                with obs_stage("resil/quarantine"):
+                    qday = resil_policy.quarantine_days(factors, universe,
+                                                        policy)
+                    sel_factors, sel_fr = resil_policy.quarantine_inputs(
+                        factors, factor_ret, qday)
             with obs_stage("selection/rolling"):
                 selection = rolling_selection(
-                    factors, returns, factor_ret, window,
+                    sel_factors, returns, sel_fr, window,
                     method=select_method, method_kwargs=select_kwargs,
                     universe=universe)
+            if fault_spec is not None:
+                with obs_stage("resil/faults"):
+                    selection = resil_faults.inject(
+                        "selection/rolling", selection, fault_spec,
+                        date_axis=0)
             if collect_probes:
                 obs_probes.probe("selection/rolling", selection)
             with obs_stage("composite/blend"):
+                # the blend consumes the ORIGINAL factors: quarantine
+                # protects the rolling windows, not the day's own
+                # cross-section (resil/policy.py module docs)
                 signal = composite_weighted(factors, names, selection,
                                             method=blend_method,
                                             universe=universe)
+            if fault_spec is not None:
+                with obs_stage("resil/faults"):
+                    signal = resil_faults.inject("composite/blend", signal,
+                                                 fault_spec, date_axis=0)
             if collect_probes:
                 # the blend leaves out-of-universe cells NaN by design, so
                 # its healthy finite fraction is the universe coverage,
                 # not 1.0
                 obs_probes.probe("composite/blend", signal,
                                  expect_finite=None)
+            clamped_cells = clamped_days = 0
+            if policy is not None:
+                with obs_stage("resil/clamp"):
+                    signal, clamped_cells, clamped_days = \
+                        resil_policy.clamp_signal(signal, policy)
             settings = SimulationSettings(
                 returns=returns, cap_flag=cap_flag,
                 investability_flag=investability, universe=universe,
-                **sim_kwargs)
+                degrade=policy, **sim_kwargs)
             sim = run_simulation(signal, settings)
             if collect_probes:
                 # per-day final ADMM residuals: the solver's convergence
@@ -186,8 +263,12 @@ def build_research_step(*, names, window: int,
             counters = None
             if collect_counters:
                 with obs_stage("obs/stage_counters"):
-                    counters = obs_counters.stage_counters(factors, universe,
-                                                           selection, sim)
+                    degrade = None
+                    if policy is not None:
+                        degrade = resil_policy.merge_stats(
+                            qday, clamped_cells, clamped_days, sim.degrade)
+                    counters = obs_counters.stage_counters(
+                        factors, universe, selection, sim, degrade=degrade)
             probes = cap.frames() if collect_probes else None
         return ResearchOutput(selection=selection, signal=signal, sim=sim,
                               summary=summary, counters=counters,

@@ -44,6 +44,7 @@ from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from factormodeling_tpu import ops
@@ -53,9 +54,9 @@ from factormodeling_tpu.obs.compile_log import entry_point_tag, instrument_jit
 from factormodeling_tpu.obs.trace import stage as obs_stage
 
 __all__ = ["chunk_sharding", "chunk_slices", "clear_streaming_cache",
-           "host_array_source", "streaming_cache_stats",
-           "streamed_factor_stats", "streamed_linear_research",
-           "streamed_weighted_composite"]
+           "host_array_source", "set_kernel_cache_size",
+           "streaming_cache_stats", "streamed_factor_stats",
+           "streamed_linear_research", "streamed_weighted_composite"]
 
 # The per-chunk jits are cached on (source, config), NOT rebuilt per call —
 # a fresh jax.jit wrapper per invocation would recompile every kernel on
@@ -88,14 +89,41 @@ def clear_streaming_cache() -> None:
 
 def streaming_cache_stats() -> dict:
     """Snapshot of the per-chunk kernel cache counters:
-    ``{"hits", "misses", "evictions", "size"}`` since the last
+    ``{"hits", "misses", "evictions", "size", "capacity"}`` since the last
     :func:`clear_streaming_cache`. A miss is a kernel (re)build — i.e. a
     fresh jit wrapper whose first call compiles; a streaming pipeline in
     steady state should show hits ~ calls and misses ~ distinct
     (source, config) pairs. A miss count growing with every call means an
     unstable source/weight-fn identity is defeating the cache (the
-    recompilation storm documented in the cache note above)."""
-    return {**_cache_stats, "size": len(_kernel_cache)}
+    recompilation storm documented in the cache note above); an eviction
+    count growing in steady state means the working set exceeds
+    ``capacity`` (:func:`set_kernel_cache_size`) and kernels are being
+    rebuilt cyclically."""
+    return {**_cache_stats, "size": len(_kernel_cache),
+            "capacity": _KERNEL_CACHE_SIZE}
+
+
+def set_kernel_cache_size(n: int) -> int:
+    """Rebound the LRU kernel cache (long-lived serving processes size it
+    to their steady-state working set; the default 16 suits the benches).
+    Shrinking evicts least-recently-used entries immediately — with their
+    pinned source closures and captured device buffers — and the
+    evictions count in :func:`streaming_cache_stats`. Returns the
+    previous capacity."""
+    global _KERNEL_CACHE_SIZE
+    if n < 1:
+        raise ValueError(f"kernel cache size must be >= 1, got {n}")
+    prev, _KERNEL_CACHE_SIZE = _KERNEL_CACHE_SIZE, int(n)
+    _evict_to_cap()
+    return prev
+
+
+def _evict_to_cap() -> None:
+    """Drop least-recently-used kernels until the cache fits the cap
+    (dict order is recency: `_cached_kernel` re-inserts on every hit)."""
+    while len(_kernel_cache) > _KERNEL_CACHE_SIZE:
+        _kernel_cache.pop(next(iter(_kernel_cache)))
+        _cache_stats["evictions"] += 1
 
 
 def _cached_kernel(source, config, build):
@@ -119,9 +147,7 @@ def _cached_kernel(source, config, build):
     else:
         _cache_stats["hits"] += 1
     _kernel_cache[key] = fn  # (re)insert at the end: dict order is recency
-    while len(_kernel_cache) > _KERNEL_CACHE_SIZE:
-        _kernel_cache.pop(next(iter(_kernel_cache)))
-        _cache_stats["evictions"] += 1
+    _evict_to_cap()
     return fn
 
 
@@ -188,9 +214,9 @@ def chunk_sharding(mesh: Mesh, date_axis: str = "date") -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(None, date_axis, None))
 
 
-def _prefetched(source, n_chunks: int, prefetch: int):
-    """Iterate ``source(0..n_chunks-1)`` with up to ``prefetch`` chunks loaded
-    ahead on a background thread.
+def _prefetched(source, n_chunks: int, prefetch: int, start: int = 0):
+    """Iterate ``source(start..n_chunks-1)`` with up to ``prefetch`` chunks
+    loaded ahead on a background thread.
 
     The host side of a source (numpy slice / disk read / network fetch) runs
     serially with device compute in the naive loop — the device sits idle for
@@ -202,13 +228,13 @@ def _prefetched(source, n_chunks: int, prefetch: int):
     *compute* dispatch stays on the caller's thread.
     """
     if prefetch <= 0:
-        for i in range(n_chunks):
+        for i in range(start, n_chunks):
             yield source(i)
         return
     with ThreadPoolExecutor(max_workers=1) as pool:
         pending = [pool.submit(source, i)
-                   for i in range(min(prefetch, n_chunks))]
-        for i in range(n_chunks):
+                   for i in range(start, min(start + prefetch, n_chunks))]
+        for i in range(start, n_chunks):
             nxt = i + len(pending)
             if nxt < n_chunks:
                 pending.append(pool.submit(source, nxt))
@@ -223,7 +249,8 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
                           fuse_source: bool = False,
                           prefetch: int = 0,
                           mesh: Mesh | None = None,
-                          date_axis: str = "date") -> dict:
+                          date_axis: str = "date",
+                          checkpoint=None) -> dict:
     """Pass 1: per-(factor, date) stats for a streamed stack.
 
     Returns the :func:`daily_factor_stats` dict with every array
@@ -234,6 +261,24 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
     ahead on a background thread so host slice/transfer overlaps device
     compute — double-buffering at 1, at the cost of one extra resident chunk
     buffer (size your chunks accordingly).
+
+    ``checkpoint``: optional
+    :class:`~factormodeling_tpu.resil.checkpoint.Checkpointer` — after
+    every chunk (thinned by its ``every``) the accumulated per-chunk
+    results snapshot atomically, and a matching snapshot on entry resumes
+    from the first unprocessed chunk. Resume is BIT-equal to the
+    uninterrupted run (the per-chunk arrays round-trip losslessly and the
+    final concatenation is the same reduction; differential-tested in
+    ``tests/test_resil.py``). A snapshot whose recorded config (chunk
+    count, stats, shift, shapes) OR input content (returns/universe
+    fingerprints, plus a re-read-chunk-0 fingerprint of non-fused
+    sources) differs from this call's is skipped with a warning — never
+    resumed into the wrong run. Trust boundary: chunks past the first
+    are NOT re-verified (re-reading them is what resumption avoids); a
+    source that changed beyond chunk 0 mid-run is the caller's problem.
+    Each save fences on its chunk's results (host transfer), so
+    checkpointing trades throughput for resumability; thin with
+    ``Checkpointer(every=k)``.
     """
     if n_chunks <= 0:
         raise ValueError(f"n_chunks must be positive, got {n_chunks}")
@@ -242,14 +287,66 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
     returns, universe = panel_put(returns), panel_put(universe)
     one = _stats_kernel(source if fuse_source else None, shift_periods,
                         tuple(stats))
+
+    start, parts = 0, []
+    ck_meta = None
+    if checkpoint is not None:
+        # numpy-only module, safe under the elision import ban (only a
+        # caller already holding a Checkpointer reaches this line)
+        from factormodeling_tpu.resil.checkpoint import fingerprint
+
+        ck_meta = {"entry": "streamed_factor_stats",
+                   "config": [int(n_chunks), list(stats),
+                              int(shift_periods), bool(fuse_source),
+                              [int(v) for v in returns.shape]],
+                   # shapes cannot tell two runs apart when only the
+                   # input CONTENT differs (another universe mask, other
+                   # returns): chunks from different inputs must never
+                   # concatenate into one result
+                   "inputs": fingerprint(returns, universe)}
+        if not fuse_source:
+            # tripwire for the streamed stack itself: already-snapshotted
+            # chunks cannot be re-verified without re-reading the source
+            # (which would defeat resumption), but re-reading ONE chunk
+            # at resume catches the likeliest corruption — a regenerated
+            # or repaired source file — at the cost of one extra chunk
+            # load per checkpointed call. Fused sources are index-only
+            # (no host-visible chunk to hash) and stay shape/config-only.
+            ck_meta["chunk0"] = fingerprint(source(0))
+        got = checkpoint.resume(expect_meta=ck_meta)
+        if got is not None:
+            state, _ = got
+            start = int(state["next_chunk"])
+            parts = list(state["parts"])
+            record_stage("streaming/resume", entry="streamed_factor_stats",
+                         resumed_chunks=start)
+
+    def _keep(part):
+        # checkpointing fetches each part to host ONCE, as it lands — a
+        # save then snapshots the accumulated host copies instead of
+        # re-transferring every prior chunk's device arrays per save
+        # (which would make the loop quadratic in device-to-host traffic)
+        if checkpoint is not None:
+            part = {k: np.asarray(v) for k, v in part.items()}
+        parts.append(part)
+
+    def _save(i):
+        if checkpoint is not None:
+            checkpoint.maybe_save(i, {"next_chunk": i + 1, "parts": parts},
+                                  meta=ck_meta)
+
     if fuse_source:
-        parts = [one(i, returns, universe) for i in range(n_chunks)]
+        for i in range(start, n_chunks):
+            _keep(one(i, returns, universe))
+            _save(i)
     else:
-        parts = [one(chunk_put(chunk), returns, universe)
-                 for chunk in _prefetched(source, n_chunks, prefetch)]
+        for i, chunk in enumerate(_prefetched(source, n_chunks, prefetch,
+                                              start=start), start=start):
+            _keep(one(chunk_put(chunk), returns, universe))
+            _save(i)
     record_stage("streaming/stats", chunks=n_chunks, fused=fuse_source,
                  prefetch=prefetch, cache=streaming_cache_stats())
-    return {k: jnp.concatenate([p[k] for p in parts], axis=0)
+    return {k: jnp.concatenate([jnp.asarray(p[k]) for p in parts], axis=0)
             for k in parts[0]}
 
 

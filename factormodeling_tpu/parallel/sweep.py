@@ -37,8 +37,8 @@ from factormodeling_tpu.obs.compile_log import entry_point_tag, instrument_jit
 from factormodeling_tpu.obs.trace import stage as obs_stage
 from factormodeling_tpu.parallel.pipeline import result_summary
 
-__all__ = ["SweepOutput", "combo_weight_matrix", "manager_sweep",
-           "make_sharded_manager_sweep"]
+__all__ = ["SweepOutput", "checkpointed_manager_sweep", "combo_weight_matrix",
+           "manager_sweep", "make_sharded_manager_sweep"]
 
 
 class SweepOutput(NamedTuple):
@@ -97,6 +97,87 @@ def manager_sweep(factors: jnp.ndarray, combo_weights: jnp.ndarray,
     with obs_stage("sweep/books"):
         books, _, _ = compute_manager_weights(factors, settings)
     return _combine_and_pnl(books, combo_weights, settings, combo_batch)
+
+
+def checkpointed_manager_sweep(factors: jnp.ndarray,
+                               combo_weights: jnp.ndarray,
+                               settings: SimulationSettings, *,
+                               combo_batch: int = 8,
+                               chunk_combos: int | None = None,
+                               checkpoint=None) -> SweepOutput:
+    """:func:`manager_sweep` as a host-chunked loop with atomic
+    snapshot/resume — the long-running form of the 1000-combo sweep
+    (BASELINE.json config 5), built for interruption.
+
+    The one-time book pass runs first (deterministic, cheap relative to
+    the combo loop — recomputed on resume rather than snapshotted: books
+    can be GBs while the per-chunk outputs are [C, D] rows); combos then
+    process in host-side chunks of ``chunk_combos`` (rounded UP to a
+    multiple of ``combo_batch`` so the device-side ``lax.map`` lanes chunk
+    identically to the uninterrupted run — the bit-equality contract),
+    each chunk's :class:`SweepOutput` appended and snapshotted via the
+    optional :class:`~factormodeling_tpu.resil.checkpoint.Checkpointer`.
+    Resume skips completed chunks and the final concatenated output is
+    bit-equal to :func:`manager_sweep` on the same inputs
+    (differential-tested in ``tests/test_resil.py``). A snapshot recorded
+    under a different (combo count, chunking, shape) config is skipped
+    with a warning.
+    """
+    c = int(combo_weights.shape[0])
+    if chunk_combos is None:
+        chunk_combos = combo_batch * 4
+    chunk_combos = max(combo_batch, -(-chunk_combos // combo_batch)
+                       * combo_batch)
+    with obs_stage("sweep/books"):
+        books, _, _ = compute_manager_weights(factors, settings)
+
+    start, parts = 0, []
+    ck_meta = None
+    if checkpoint is not None:
+        from factormodeling_tpu.resil.checkpoint import fingerprint
+
+        # content guard over EVERY input: settings is a registered pytree,
+        # so its leaves cover all panels and float knobs and its treedef
+        # repr carries the static fields (method, covariance, ...) — a
+        # same-shaped run differing in any of them must not resume this
+        # snapshot's chunks
+        ck_meta = {"entry": "manager_sweep",
+                   "config": [c, int(chunk_combos), int(combo_batch),
+                              [int(v) for v in factors.shape],
+                              str(jax.tree_util.tree_structure(settings))],
+                   "inputs": fingerprint(*jax.tree_util.tree_leaves(
+                       (combo_weights, factors, settings)))}
+        got = checkpoint.resume(expect_meta=ck_meta)
+        if got is not None:
+            state, _ = got
+            start = int(state["next_chunk"])
+            parts = [SweepOutput(**p) for p in state["parts"]]
+            record_stage("parallel/sweep_resume", resumed_chunks=start)
+
+    bounds = [(i, min(i + chunk_combos, c))
+              for i in range(0, c, chunk_combos)]
+    for idx in range(start, len(bounds)):
+        lo, hi = bounds[idx]
+        out = _combine_and_pnl(books, combo_weights[lo:hi], settings,
+                               combo_batch)
+        if checkpoint is not None:
+            # fetch to host ONCE as the chunk lands: each save snapshots
+            # the accumulated host copies rather than re-transferring
+            # every prior chunk's device arrays (quadratic traffic)
+            out = SweepOutput(**{k: np.asarray(v)
+                                 for k, v in out._asdict().items()})
+        parts.append(out)
+        if checkpoint is not None:
+            checkpoint.maybe_save(
+                idx, {"next_chunk": idx + 1,
+                      "parts": [p._asdict() for p in parts]},
+                meta=ck_meta)
+    record_stage("parallel/sweep", combos=c, factors=int(factors.shape[0]),
+                 combo_batch=combo_batch, chunked=chunk_combos,
+                 resumed_chunks=start)
+    return SweepOutput(*[jnp.concatenate(
+        [jnp.asarray(getattr(p, f)) for p in parts], axis=0)
+        for f in SweepOutput._fields])
 
 
 def make_sharded_manager_sweep(mesh: Mesh, *, combo_axis: str = "combo",

@@ -1,0 +1,49 @@
+"""Resilience layer: detect -> degrade -> recover (docs/architecture.md §18).
+
+Three legs close the loop the observability subsystem (PRs 2/4/5) left
+open at "detect":
+
+- :mod:`~factormodeling_tpu.resil.faults` — seedable, fully-traced fault
+  injection at the research step's stage boundaries (NaN bursts, Inf
+  spikes, outliers, stale/dropped dates, universe collapse), off-by-default
+  with argument-presence structural elision.
+- :mod:`~factormodeling_tpu.resil.policy` — the branchless
+  :class:`DegradePolicy` (NaN-day quarantine, absmax clamp, min-universe
+  hold, solver-fallback carry) with :class:`DegradeStats` counters riding
+  ``StageCounters`` into reports; the default policy is bit-inert.
+- :mod:`~factormodeling_tpu.resil.checkpoint` — versioned, checksummed,
+  atomic snapshot/resume for the streaming chunk loop, the combo sweep,
+  and the chaos matrix, with retry/backoff host IO.
+
+``tools/chaos.py`` drives the matrix: fault classes x policies, asserting
+finite P&L, dollar neutrality, weight/turnover bounds, and watchdog
+attribution of the injected stage in every cell.
+"""
+
+from factormodeling_tpu.resil.checkpoint import (  # noqa: F401
+    SNAPSHOT_VERSION,
+    Checkpointer,
+    SnapshotCorrupt,
+    fingerprint,
+    io_retry,
+    load_snapshot,
+    save_snapshot,
+)
+from factormodeling_tpu.resil.faults import (  # noqa: F401
+    FAULT_CLASSES,
+    INJECT_STAGES,
+    FaultSpec,
+    inject,
+    inject_universe,
+    staleness_canary,
+)
+from factormodeling_tpu.resil.policy import (  # noqa: F401
+    DegradePolicy,
+    DegradeStats,
+    HoldStats,
+    clamp_signal,
+    hold_weights,
+    merge_stats,
+    quarantine_days,
+    quarantine_inputs,
+)

@@ -1,0 +1,297 @@
+"""Versioned, checksummed, atomic snapshot/resume for long-running loops.
+
+ROADMAP items 1 and 3 (many-tenant serving, online daily advance) imply
+processes that run for hours and must survive interruption: the streaming
+chunk loop (``parallel/streaming.py``), the combo sweep
+(``parallel/sweep.py``), and the chaos matrix (``tools/chaos.py``) all
+accumulate host-side state chunk by chunk. This module gives them one
+snapshot format with production failure semantics:
+
+- **atomic**: snapshots write to a tempfile in the target directory and
+  ``os.replace`` into place — a kill mid-write leaves the PREVIOUS
+  snapshot intact, never a half-written one (the mid-run-kill test in
+  ``tests/test_chaos.py`` SIGKILLs a matrix run and resumes bit-equal).
+- **checksummed + versioned**: the header carries a format version and the
+  SHA-256 of the payload; a flipped bit or truncated tail raises
+  :class:`SnapshotCorrupt` with the reason — a corrupt snapshot is
+  REJECTED, never silently half-loaded (``Checkpointer.resume`` can
+  instead discard-and-restart on request).
+- **self-describing**: state is any JSON-like tree (dict / list / tuple /
+  None / str-int-float-bool leaves) of numpy/JAX arrays, encoded without
+  pickle — the container structure lives in the JSON header, the arrays
+  in an embedded ``.npz`` payload. Typed pytrees (``ADMMWarmState``,
+  report row lists, fault specs) round-trip via ``load(..., like=...)``,
+  which re-hangs the loaded leaves on a template's treedef.
+- **retried**: all host IO runs under :func:`io_retry` (bounded retries
+  with backoff) so a transient ``OSError`` — NFS hiccup, busy volume —
+  degrades to a delay instead of killing an hours-long run.
+
+Snapshots also carry a caller ``meta`` dict; ``Checkpointer.resume``
+matches it against the caller's current config (``expect_meta``) so a
+stale snapshot from a DIFFERENT configuration is skipped with a warning
+rather than resumed into the wrong run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["SNAPSHOT_VERSION", "Checkpointer", "SnapshotCorrupt",
+           "fingerprint", "io_retry", "load_snapshot", "save_snapshot"]
+
+#: snapshot format version; bump on incompatible header/payload changes.
+#: Loads refuse mismatched versions (a refused version IS a corrupt
+#: snapshot from the resuming run's point of view).
+SNAPSHOT_VERSION = 1
+
+_MAGIC = b"FMTSNAP1"
+
+
+class SnapshotCorrupt(RuntimeError):
+    """The snapshot file failed validation (magic/version/checksum/
+    structure) — resume must not trust any of it."""
+
+
+def fingerprint(*arrays) -> str:
+    """Short content hash (dtype + shape + bytes; None hashes as its own
+    token) for ``Checkpointer.resume(expect_meta=...)`` config guards:
+    shapes alone cannot tell two runs apart when only the input CONTENT
+    differs (a different universe mask, different returns), and resuming
+    chunk results computed from different inputs silently corrupts the
+    concatenated output. Fetches device arrays to host once — size the
+    fingerprinted set accordingly (it runs once per save/resume)."""
+    h = hashlib.sha256()
+    for a in arrays:
+        if a is None:
+            h.update(b"\x00none")
+            continue
+        arr = np.asarray(a)
+        h.update(str(arr.dtype).encode() + b"|" + str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def io_retry(fn, *, retries: int = 3, backoff: float = 0.05,
+             exceptions=(OSError,), no_retry=()):
+    """Run ``fn()`` with bounded retries and exponential backoff on host-IO
+    errors. The LAST failure propagates — retry hides transient faults,
+    not real ones — and ``no_retry`` exceptions propagate IMMEDIATELY
+    (a deterministic condition like a missing snapshot is not a fault to
+    wait out)."""
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except exceptions as e:
+            if isinstance(e, no_retry) or attempt == retries:
+                raise
+            time.sleep(backoff * (2 ** attempt))
+
+
+def _encode(tree, leaves: list):
+    """Recursive structure descriptor; array leaves move to ``leaves``."""
+    if tree is None:
+        return {"t": "none"}
+    if isinstance(tree, dict):
+        return {"t": "dict", "k": {str(k): _encode(v, leaves)
+                                   for k, v in tree.items()}}
+    if isinstance(tree, (list, tuple)):
+        return {"t": "list" if isinstance(tree, list) else "tuple",
+                "v": [_encode(v, leaves) for v in tree]}
+    if isinstance(tree, (str, bool, int, float)):
+        return {"t": "json", "v": tree}
+    arr = np.asarray(tree)
+    if arr.dtype == object:
+        raise TypeError(f"snapshot leaves must be arrays or JSON scalars, "
+                        f"got object array from {type(tree).__name__}")
+    leaves.append(arr)
+    return {"t": "leaf", "i": len(leaves) - 1}
+
+
+def _decode(desc, leaves):
+    t = desc["t"]
+    if t == "none":
+        return None
+    if t == "dict":
+        return {k: _decode(v, leaves) for k, v in desc["k"].items()}
+    if t in ("list", "tuple"):
+        out = [_decode(v, leaves) for v in desc["v"]]
+        return out if t == "list" else tuple(out)
+    if t == "json":
+        return desc["v"]
+    if t == "leaf":
+        return leaves[desc["i"]]
+    raise SnapshotCorrupt(f"unknown structure node type {t!r}")
+
+
+def save_snapshot(path, state, *, meta: dict | None = None,
+                  retries: int = 3, backoff: float = 0.05) -> Path:
+    """Atomically write ``state`` (a JSON-like tree of array leaves — see
+    module docs) plus ``meta`` to ``path``. Returns the path."""
+    path = Path(path)
+    leaves: list = []
+    structure = _encode(state, leaves)
+    buf = io.BytesIO()
+    np.savez(buf, **{f"L{i}": a for i, a in enumerate(leaves)})
+    payload = buf.getvalue()
+    header = json.dumps({
+        "version": SNAPSHOT_VERSION,
+        "sha256": hashlib.sha256(payload).hexdigest(),
+        "n_leaves": len(leaves),
+        "meta": meta or {},
+        "structure": structure,
+    }).encode()
+
+    def write():
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=path.parent,
+                                   prefix=path.name + ".tmp")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(_MAGIC)
+                fh.write(len(header).to_bytes(8, "big"))
+                fh.write(header)
+                fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)   # atomic on POSIX: old snapshot or new,
+        finally:                    # never half of either
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return path
+
+    return io_retry(write, retries=retries, backoff=backoff)
+
+
+def load_snapshot(path, *, like=None, retries: int = 3,
+                  backoff: float = 0.05):
+    """Validated load: returns ``(state, meta)``. Raises
+    :class:`SnapshotCorrupt` on any validation failure (bad magic/version,
+    checksum mismatch, truncation, undecodable structure) and
+    ``FileNotFoundError`` when the file is absent — callers distinguish
+    "never checkpointed" from "checkpoint damaged".
+
+    ``like``: optional pytree template; the loaded leaves are re-hung on
+    its treedef (``jax.tree_util``), recovering typed pytrees (NamedTuples,
+    registered dataclasses) the structure codec stored as plain
+    containers. Leaf COUNT must match the template's."""
+    path = Path(path)
+    # a missing file is "never checkpointed", not a transient IO fault:
+    # propagate immediately instead of sleeping through the retry ladder
+    # (every fresh checkpointed run resolves resume() through this path)
+    raw = io_retry(path.read_bytes, retries=retries, backoff=backoff,
+                   no_retry=(FileNotFoundError,))
+    if len(raw) < len(_MAGIC) + 8 or raw[:len(_MAGIC)] != _MAGIC:
+        raise SnapshotCorrupt(f"{path}: missing/garbled snapshot magic")
+    hlen = int.from_bytes(raw[len(_MAGIC):len(_MAGIC) + 8], "big")
+    hstart = len(_MAGIC) + 8
+    if hstart + hlen > len(raw):
+        raise SnapshotCorrupt(f"{path}: truncated header")
+    try:
+        header = json.loads(raw[hstart:hstart + hlen])
+    except json.JSONDecodeError as e:
+        raise SnapshotCorrupt(f"{path}: undecodable header ({e})") from None
+    if header.get("version") != SNAPSHOT_VERSION:
+        raise SnapshotCorrupt(
+            f"{path}: snapshot version {header.get('version')} != "
+            f"supported {SNAPSHOT_VERSION}")
+    payload = raw[hstart + hlen:]
+    digest = hashlib.sha256(payload).hexdigest()
+    if digest != header.get("sha256"):
+        raise SnapshotCorrupt(
+            f"{path}: payload checksum mismatch (stored "
+            f"{str(header.get('sha256'))[:12]}..., computed {digest[:12]}...)"
+            " — truncated or bit-flipped snapshot")
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            leaves = [z[f"L{i}"] for i in range(int(header["n_leaves"]))]
+        state = _decode(header["structure"], leaves)
+    except SnapshotCorrupt:
+        raise
+    except Exception as e:
+        raise SnapshotCorrupt(f"{path}: undecodable payload ({e})") from None
+    if like is not None:
+        import jax
+
+        treedef = jax.tree_util.tree_structure(like)
+        flat = jax.tree_util.tree_leaves(state)
+        if len(flat) != treedef.num_leaves:
+            raise SnapshotCorrupt(
+                f"{path}: {len(flat)} leaves do not fit the template's "
+                f"{treedef.num_leaves}")
+        state = jax.tree_util.tree_unflatten(treedef, flat)
+    return state, header.get("meta", {})
+
+
+class Checkpointer:
+    """Save/resume convenience over one snapshot path.
+
+    ``every`` thins saves (``maybe_save(i, ...)`` writes on every
+    ``every``-th completed index; call :meth:`save` explicitly at loop
+    exit if the tail between grid points must not be lost). ``resume``
+    returns ``(state, meta)`` or None (no snapshot / config mismatch);
+    corruption raises by default — pass ``on_corrupt="discard"`` to warn
+    and restart fresh.
+    """
+
+    def __init__(self, path, *, every: int = 1, retries: int = 3,
+                 backoff: float = 0.05):
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.path = Path(path)
+        self.every = int(every)
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+
+    def save(self, state, *, meta: dict | None = None) -> Path:
+        return save_snapshot(self.path, state, meta=meta,
+                             retries=self.retries, backoff=self.backoff)
+
+    def maybe_save(self, i: int, state, *, meta: dict | None = None):
+        """Save when ``i`` lands on the ``every`` grid (i is 0-based; the
+        i-th completed unit of work)."""
+        if (i + 1) % self.every == 0:
+            return self.save(state, meta=meta)
+        return None
+
+    def resume(self, *, like=None, expect_meta: dict | None = None,
+               on_corrupt: str = "raise"):
+        """``(state, meta)`` from the snapshot, or None when there is
+        nothing valid to resume.
+
+        ``expect_meta``: key/value pairs that must match the snapshot's
+        meta (config guard) — a mismatch warns and returns None, so a
+        snapshot from a different configuration can never be resumed into
+        this run. ``on_corrupt``: "raise" (default) propagates
+        :class:`SnapshotCorrupt`; "discard" warns and returns None.
+        """
+        if on_corrupt not in ("raise", "discard"):
+            raise ValueError(f"on_corrupt must be 'raise' or 'discard', "
+                             f"got {on_corrupt!r}")
+        try:
+            state, meta = load_snapshot(self.path, like=like,
+                                        retries=self.retries,
+                                        backoff=self.backoff)
+        except FileNotFoundError:
+            return None
+        except SnapshotCorrupt as e:
+            if on_corrupt == "raise":
+                raise
+            print(f"warning: discarding corrupt snapshot: {e}",
+                  file=sys.stderr)
+            return None
+        for key, want in (expect_meta or {}).items():
+            if meta.get(key) != want:
+                print(f"warning: snapshot {self.path} is for a different "
+                      f"configuration ({key}={meta.get(key)!r}, expected "
+                      f"{want!r}) — starting fresh", file=sys.stderr)
+                return None
+        return state, meta

@@ -1,0 +1,233 @@
+"""Deterministic fault injection at the research step's stage boundaries.
+
+The reference pipeline fails *silently* under hostile inputs — a NaN factor
+row propagates through the rolling IC window, a degenerate universe day
+crashes the per-date solve — and PRs 4/5 built the *detection* half of a
+production response (probes, watchdog, placement ledger). This module is
+the test harness for the *response* half: seedable, reproducible corruption
+of the step's inputs and intermediates so the degradation policy
+(:mod:`factormodeling_tpu.resil.policy`) and the chaos matrix
+(``tools/chaos.py``) can exercise every failure class on demand, inside
+the jitted step, with the watchdog attributing each fault to the stage
+that birthed it.
+
+Gating contract (the counters/probes idiom, ``obs/counters.py``): injection
+is decided at TRACE time by ARGUMENT PRESENCE. ``build_research_step``'s
+returned step takes ``fault_spec=None`` — with None (the default) no
+injection subgraph is ever traced and the step's HLO is byte-identical to
+a build without this module (pinned in ``tests/test_resil.py``). With a
+:class:`FaultSpec`, every field is a TRACED array leaf, so one compiled
+step serves the whole chaos matrix — fault classes, rates, seeds, and
+target stages are runtime values, not trace constants, and the clean
+baseline is simply the all-zero-rate spec (:meth:`FaultSpec.off`), which
+produces bit-identical outputs through the same executable (``jnp.where``
+with an all-False mask selects the original operand exactly).
+
+Fault taxonomy (``FAULT_CLASSES``) and where the watchdog sees each one
+(docs/architecture.md §18 has the full table):
+
+- ``nan_burst`` — random cells -> NaN. Finite-fraction drop at the
+  injected stage.
+- ``inf_spike`` — random cells -> +-Inf (sign-preserving). Finite-fraction
+  drop at the injected stage.
+- ``outlier`` — random cells scaled to ``~10**outlier_mag``. Absmax blowup
+  at the injected stage (the watchdog's baseline-relative absmax check).
+- ``stale_repeat`` — random dates replaced by the PREVIOUS date's rows (a
+  stale feed re-serving yesterday's file). Invisible to finite/absmax
+  summaries by construction; detected by the day-over-day delta canary
+  probe (``ops/factors_delta``) the faulted build adds — a stale day
+  zeroes its delta rows, dropping the canary's nonzero count.
+- ``drop_day`` — random dates replaced by all-NaN rows (a dropped date IS
+  a missing row in a dense panel). Finite-fraction drop at the injected
+  stage. Duplicated-date feeds are the same transform as ``stale_repeat``
+  (day d re-serves day d-1) and are covered by it.
+- ``universe_collapse`` — random dates keep only ``collapse_keep``
+  investable names. Targets the UNIVERSE input (not a stage tensor);
+  manifests at ``composite/blend``, whose finite fraction IS the universe
+  coverage (the blend leaves out-of-universe cells NaN by design).
+
+Cell faults apply first, then staleness, then drops — so a dropped day is
+dropped regardless of what else hit it, and a stale day re-serves the
+(possibly corrupted) previous day, like a real stale feed would.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import random
+
+__all__ = ["FAULT_CLASSES", "INJECT_STAGES", "FaultSpec", "inject",
+           "inject_universe", "staleness_canary"]
+
+#: stage boundaries whose tensors the injectors can corrupt, in trace
+#: order: the raw factor stack [F, D, N], the selection matrix [D, F], and
+#: the composite signal [D, N]. ``FaultSpec.stage_gate`` indexes this tuple.
+INJECT_STAGES = ("ops/factors_raw", "selection/rolling", "composite/blend")
+
+#: the fault classes the spec can express (see module docs for semantics
+#: and watchdog visibility).
+FAULT_CLASSES = ("nan_burst", "inf_spike", "outlier", "stale_repeat",
+                 "drop_day", "universe_collapse")
+
+# disjoint fold_in lanes per fault class so changing one class's rate
+# never reshuffles another's mask (the chaos matrix diffs cells against
+# the clean baseline cell-by-cell)
+_LANE = {name: 7919 + 31 * i for i, name in enumerate(FAULT_CLASSES)}
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Seedable fault configuration — every field a traced array leaf.
+
+    Rates are per-cell (``nan_rate``/``inf_rate``/``outlier_rate``) or
+    per-date (``stale_rate``/``drop_rate``/``collapse_rate``) Bernoulli
+    probabilities; ``stage_gate`` is a ``float[len(INJECT_STAGES)]`` mask
+    scaling every tensor fault at that stage (1.0 = inject there, 0.0 =
+    leave alone), so a one-hot gate targets a single boundary.
+    ``universe_collapse`` ignores the gate — the universe is an input, not
+    a stage tensor. Two runs with equal specs corrupt identical cells
+    (``jax.random`` keyed on ``seed`` x stage x class).
+    """
+
+    seed: jnp.ndarray            # int32[] PRNG root
+    stage_gate: jnp.ndarray      # float[len(INJECT_STAGES)]
+    nan_rate: jnp.ndarray        # float[] per-cell
+    inf_rate: jnp.ndarray        # float[] per-cell
+    outlier_rate: jnp.ndarray    # float[] per-cell
+    outlier_mag: jnp.ndarray     # float[] log10 of the outlier scale
+    stale_rate: jnp.ndarray      # float[] per-date
+    drop_rate: jnp.ndarray       # float[] per-date
+    collapse_rate: jnp.ndarray   # float[] per-date (universe input)
+    collapse_keep: jnp.ndarray   # int32[] names kept on collapsed dates
+
+    @classmethod
+    def make(cls, *, seed: int = 0, stage: str | None = None,
+             nan_rate=0.0, inf_rate=0.0, outlier_rate=0.0, outlier_mag=9.0,
+             stale_rate=0.0, drop_rate=0.0, collapse_rate=0.0,
+             collapse_keep: int = 1) -> "FaultSpec":
+        """Build a spec from python scalars. ``stage=None`` gates every
+        stage on; a stage name gates exactly that boundary."""
+        if stage is None:
+            gate = jnp.ones((len(INJECT_STAGES),), jnp.float32)
+        else:
+            idx = INJECT_STAGES.index(stage)
+            gate = jnp.zeros((len(INJECT_STAGES),), jnp.float32).at[idx].set(1.0)
+        f32 = lambda v: jnp.asarray(float(v), jnp.float32)  # noqa: E731
+        return cls(seed=jnp.asarray(int(seed), jnp.int32), stage_gate=gate,
+                   nan_rate=f32(nan_rate), inf_rate=f32(inf_rate),
+                   outlier_rate=f32(outlier_rate), outlier_mag=f32(outlier_mag),
+                   stale_rate=f32(stale_rate), drop_rate=f32(drop_rate),
+                   collapse_rate=f32(collapse_rate),
+                   collapse_keep=jnp.asarray(int(collapse_keep), jnp.int32))
+
+    @classmethod
+    def off(cls, seed: int = 0) -> "FaultSpec":
+        """The all-zero-rate spec: traces the injection subgraph (same
+        executable as any faulted cell) but corrupts nothing — the chaos
+        matrix's clean baseline."""
+        return cls.make(seed=seed)
+
+    @classmethod
+    def single(cls, kind: str, *, stage: str = "ops/factors_raw",
+               rate: float = 0.05, seed: int = 0, magnitude: float = 9.0,
+               keep: int = 1) -> "FaultSpec":
+        """One fault class at one boundary — the chaos matrix's cell
+        constructor. ``magnitude`` is the outlier's log10 scale; ``keep``
+        the surviving names of a collapsed universe date."""
+        if kind not in FAULT_CLASSES:
+            raise ValueError(f"unknown fault class {kind!r}; valid: "
+                             f"{FAULT_CLASSES}")
+        kw = {"nan_burst": {"nan_rate": rate},
+              "inf_spike": {"inf_rate": rate},
+              "outlier": {"outlier_rate": rate, "outlier_mag": magnitude},
+              "stale_repeat": {"stale_rate": rate},
+              "drop_day": {"drop_rate": rate},
+              "universe_collapse": {"collapse_rate": rate,
+                                    "collapse_keep": keep}}[kind]
+        return cls.make(seed=seed, stage=stage, **kw)
+
+
+def _key(spec: FaultSpec, stage_idx: int, kind: str):
+    return random.fold_in(random.fold_in(random.PRNGKey(spec.seed),
+                                         stage_idx), _LANE[kind])
+
+
+def _day_mask(shape, date_axis: int, mask_d):
+    """Broadcast a [D] day mask over a tensor with dates on ``date_axis``."""
+    view = [1] * len(shape)
+    view[date_axis] = shape[date_axis]
+    return mask_d.reshape(view)
+
+
+def inject(stage: str, x, spec: FaultSpec | None, *, date_axis: int = 0):
+    """Corrupt one stage tensor per the spec (traceable; returns ``x``
+    untouched — and traces NOTHING — when ``spec`` is None).
+
+    ``date_axis`` locates the date dimension for the day-level classes
+    (factor stacks [F, D, N] pass 1; panels/matrices [D, ...] pass 0).
+    """
+    if spec is None or x is None:
+        return x
+    idx = INJECT_STAGES.index(stage)
+    gate = spec.stage_gate[idx].astype(x.dtype)
+    d = x.shape[date_axis]
+    days = jnp.arange(d)
+
+    def cell_mask(kind, rate):
+        u = random.uniform(_key(spec, idx, kind), x.shape)
+        return u < gate * rate.astype(x.dtype)
+
+    # cell classes first (a stale day re-serves the corrupted previous day,
+    # like a real stale feed re-serving yesterday's already-bad file)
+    x = jnp.where(cell_mask("nan_burst", spec.nan_rate), jnp.nan, x)
+    spike = jnp.where(jnp.nan_to_num(x) < 0, -jnp.inf, jnp.inf).astype(x.dtype)
+    x = jnp.where(cell_mask("inf_spike", spec.inf_rate), spike, x)
+    blast = ((jnp.nan_to_num(x) + 1.0)
+             * 10.0 ** spec.outlier_mag.astype(x.dtype))
+    x = jnp.where(cell_mask("outlier", spec.outlier_rate), blast, x)
+
+    def day_mask(kind, rate, skip_first):
+        u = random.uniform(_key(spec, idx, kind), (d,))
+        m = u < gate * rate.astype(u.dtype)
+        return m & (days > 0) if skip_first else m
+
+    stale = day_mask("stale_repeat", spec.stale_rate, skip_first=True)
+    prev = jnp.take(x, jnp.maximum(days - 1, 0), axis=date_axis)
+    x = jnp.where(_day_mask(x.shape, date_axis, stale), prev, x)
+    drop = day_mask("drop_day", spec.drop_rate, skip_first=False)
+    x = jnp.where(_day_mask(x.shape, date_axis, drop), jnp.nan, x)
+    return x
+
+
+def inject_universe(universe, spec: FaultSpec | None):
+    """Collapse random dates of a ``bool[D, N]`` universe to the first
+    ``collapse_keep`` members (traceable; identity when either is None).
+    Ungated by ``stage_gate`` — the universe is an input, and the collapse
+    manifests downstream at ``composite/blend`` (see module docs)."""
+    if spec is None or universe is None:
+        return universe
+    d, _ = universe.shape
+    u = random.uniform(_key(spec, 0, "universe_collapse"), (d,))
+    day = u < spec.collapse_rate
+    rank = jnp.cumsum(universe.astype(jnp.int32), axis=1)
+    collapsed = universe & (rank <= spec.collapse_keep)
+    return jnp.where(day[:, None], collapsed, universe)
+
+
+def staleness_canary(factors: jnp.ndarray, *, date_axis: int = 1):
+    """Day-over-day delta of the factor stack, first date NaN'd out — the
+    probe target that makes ``stale_repeat``/duplicated-date faults
+    visible: a stale day's delta rows are exactly zero, so the canary's
+    nonzero count (the probe's ``log2_hist`` total) drops against the
+    clean baseline while finite fraction and absmax stand still.
+
+    Roll-based (not diff+concat) for the same GSPMD reason as the
+    selection-churn counter (``obs/counters.py``)."""
+    d = factors.shape[date_axis]
+    delta = factors - jnp.roll(factors, 1, axis=date_axis)
+    first = _day_mask(factors.shape, date_axis, jnp.arange(d) == 0)
+    return jnp.where(first, jnp.nan, delta)

@@ -1,0 +1,195 @@
+"""Device-side graceful degradation: the response half of detect->degrade.
+
+PR 4's watchdog can say WHERE a NaN was born; nothing so far changes what
+the step does about it. A :class:`DegradePolicy` closes that loop with four
+guards, each branchless (``jnp.where`` selects, one trace) and each
+counted (:class:`DegradeStats` rides ``StageCounters`` into reports, and
+``tools/report_diff.py`` gates on ``degrade_events`` growth):
+
+- **NaN-day factor quarantine** (``quarantine_nan_frac``): a date whose
+  in-universe factor NaN share exceeds the threshold is masked OUT of the
+  rolling selection windows (its daily stats become NaN, which the
+  NaN-aware rolling reducers skip — ``metrics.rolling_metrics``) instead
+  of feeding a garbage IC into every window that covers it. The date still
+  trades (its weights come from the surviving window history); only its
+  own corrupt evidence is excluded. The blend keeps the ORIGINAL factors —
+  quarantine protects the windowed statistics, not the day's cross-section.
+- **absmax clamp** (``clamp_absmax``): the composite signal is clamped to
+  ``+-clamp_absmax`` before the backtest, so an outlier/Inf burst cannot
+  drive the QP's objective off the rails. Key the threshold to the clean
+  run's probe absmax (``tools/chaos.py`` uses ``8x`` the clean
+  ``composite/blend`` absmax). NaN passes through (the engine's ladder
+  owns NaN semantics).
+- **min-universe guard** (``min_universe``): a date with fewer investable
+  names HOLDS the previous date's traded book instead of rebalancing into
+  a degenerate cross-section (the reference crashes here; our ladder
+  zeroes the day — flat). Applied to the PRE-SHIFT weights for every
+  scheme uniformly, so it is an execution-layer guard: the solver's own
+  day-over-day chain (turnover w_prev) keeps its notional path, and the
+  EXECUTED book is what holds (docs/architecture.md §18 discusses this
+  choice honestly).
+- **solver-fallback carry** (``carry_fallback``): the explicit fallback
+  ladder — polish-reject -> plain ADMM exit (both existing solver
+  semantics) -> carry the previous traded book (this guard) ->
+  equal-weight leg (the reference's silent fallback, which remains the
+  floor: day 0 and flat predecessors have nothing to carry, and a carried
+  zero book is a flat day). Implemented in the same pre-shift hold pass
+  as the min-universe guard, keyed on the scheme's per-day ``solver_ok``.
+
+Default contract: ``DegradePolicy.make()`` (all guards off) produces
+BIT-IDENTICAL outputs to ``policy=None`` — every mask is all-False and
+``jnp.where`` then selects the original operand exactly — and
+``policy=None`` traces none of this (argument-presence elision, pinned in
+``tests/test_resil.py``). All fields are traced array leaves, so one
+compiled step serves every policy in a chaos matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["DegradePolicy", "DegradeStats", "HoldStats", "clamp_signal",
+           "hold_weights", "merge_stats", "quarantine_days",
+           "quarantine_inputs"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DegradePolicy:
+    """Degradation thresholds — every field a traced array leaf (see
+    module docs for semantics; :meth:`make` builds one from scalars)."""
+
+    min_universe: jnp.ndarray        # int32[]; 0 disables the hold guard
+    quarantine_nan_frac: jnp.ndarray  # float[]; > 1 disables quarantine
+    clamp_absmax: jnp.ndarray        # float[]; inf disables the clamp
+    carry_fallback: jnp.ndarray      # bool[]; False = equal-x0 floor only
+
+    @classmethod
+    def make(cls, *, min_universe: int = 0, quarantine_nan_frac: float = 2.0,
+             clamp_absmax: float = float("inf"),
+             carry_fallback: bool = False) -> "DegradePolicy":
+        return cls(
+            min_universe=jnp.asarray(int(min_universe), jnp.int32),
+            quarantine_nan_frac=jnp.asarray(float(quarantine_nan_frac),
+                                            jnp.float32),
+            clamp_absmax=jnp.asarray(float(clamp_absmax), jnp.float32),
+            carry_fallback=jnp.asarray(bool(carry_fallback)))
+
+
+class DegradeStats(NamedTuple):
+    """Per-run degradation tallies (all ``int32[]``), merged into
+    :class:`~factormodeling_tpu.obs.counters.StageCounters` (zeros when no
+    policy is wired) and gated up by ``tools/report_diff.py``.
+
+    quarantined_days: dates masked out of the rolling windows.
+    held_days: dates whose book held on the min-universe guard.
+    carry_days: dates whose book carried on a solver fallback.
+    clamped_cells: signal cells clamped to ``+-clamp_absmax``.
+    degrade_events: quarantined + held + carried + clamped DATES — the one
+      scalar whose growth against a baseline report is a regression (a
+      healthy feed degrades nowhere).
+    """
+
+    quarantined_days: jnp.ndarray
+    held_days: jnp.ndarray
+    carry_days: jnp.ndarray
+    clamped_cells: jnp.ndarray
+    degrade_events: jnp.ndarray
+
+    @classmethod
+    def zeros(cls) -> "DegradeStats":
+        z = jnp.zeros((), jnp.int32)
+        return cls(z, z, z, z, z)
+
+
+class HoldStats(NamedTuple):
+    """The engine-side slice of :class:`DegradeStats` (``hold_weights``'s
+    tallies), carried on ``SimulationOutput.degrade``."""
+
+    held_days: jnp.ndarray   # int32[]
+    carry_days: jnp.ndarray  # int32[]
+
+
+def quarantine_days(factors: jnp.ndarray, universe,
+                    policy: DegradePolicy) -> jnp.ndarray:
+    """``bool[D]``: dates whose in-universe factor NaN share exceeds the
+    quarantine threshold. With no universe, every cell counts."""
+    f, d, n = factors.shape
+    nan = jnp.isnan(factors)
+    if universe is not None:
+        nan = nan & universe
+        denom = jnp.maximum(universe.sum(-1) * f, 1)
+    else:
+        denom = jnp.full((d,), n * f)
+    frac = nan.sum((0, -1)) / denom.astype(factors.dtype)
+    return frac > policy.quarantine_nan_frac.astype(factors.dtype)
+
+
+def quarantine_inputs(factors: jnp.ndarray, factor_ret: jnp.ndarray, qday):
+    """NaN out the quarantined dates of the SELECTION inputs — their daily
+    stats become NaN and the NaN-aware rolling windows skip them."""
+    f_sel = jnp.where(qday[None, :, None], jnp.nan, factors)
+    fr_sel = jnp.where(qday[:, None], jnp.nan, factor_ret)
+    return f_sel, fr_sel
+
+
+def clamp_signal(signal: jnp.ndarray, policy: DegradePolicy):
+    """Clamp the composite to ``+-clamp_absmax`` (Inf clamps too; NaN
+    passes through). Returns ``(clamped, clamped_cells, clamped_days)``.
+    With the default ``inf`` threshold the clamp is a bitwise identity."""
+    c = policy.clamp_absmax.astype(signal.dtype)
+    over = jnp.abs(signal) > c          # False for NaN; True for Inf
+    clamped = jnp.clip(signal, -c, c)
+    return (clamped, over.sum().astype(jnp.int32),
+            over.any(-1).sum().astype(jnp.int32))
+
+
+def hold_weights(w: jnp.ndarray, lc, sc, solver_ok, universe_count,
+                 policy: DegradePolicy):
+    """The pre-shift hold pass: dates failing the min-universe guard — or,
+    with ``carry_fallback``, dates whose solve fell back — re-trade the
+    previous date's final book (day 0 holds to zeros: a flat day).
+
+    ``solver_ok`` is the scheme's per-day acceptance with ladder days
+    already marked ok (``mvo._finalize``), so the carry tier engages on
+    GENUINE solver fallbacks only. Leg counts on held dates are recounted
+    from the held book. Returns ``(w, lc, sc, HoldStats)``; with the
+    default policy every mask is all-False and the outputs are bitwise
+    the inputs."""
+    held_mu = universe_count < policy.min_universe
+    carried = policy.carry_fallback & ~solver_ok & ~held_mu
+    hold = held_mu | carried
+
+    def step(prev_w, xs):
+        w_d, hold_d = xs
+        out = jnp.where(hold_d, prev_w, w_d)
+        return out, out
+
+    _, w2 = lax.scan(step, jnp.zeros_like(w[0]), (w, hold))
+    lc2 = jnp.where(hold, (w2 > 0).sum(-1).astype(lc.dtype), lc)
+    sc2 = jnp.where(hold, (w2 < 0).sum(-1).astype(sc.dtype), sc)
+    stats = HoldStats(held_days=held_mu.sum().astype(jnp.int32),
+                      carry_days=carried.sum().astype(jnp.int32))
+    return w2, lc2, sc2, stats
+
+
+def merge_stats(qday, clamped_cells, clamped_days,
+                hold: HoldStats | None) -> DegradeStats:
+    """Fold the pipeline-side tallies (quarantine, clamp) and the engine's
+    :class:`HoldStats` into one :class:`DegradeStats`."""
+    i32 = jnp.int32
+    zero = jnp.zeros((), i32)
+    q = zero if qday is None else qday.sum().astype(i32)
+    held = zero if hold is None else hold.held_days
+    carry = zero if hold is None else hold.carry_days
+    cells = jnp.asarray(clamped_cells, i32)
+    days = jnp.asarray(clamped_days, i32)
+    return DegradeStats(
+        quarantined_days=q, held_days=held, carry_days=carry,
+        clamped_cells=cells,
+        degrade_events=q + held + carry + days)

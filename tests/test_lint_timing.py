@@ -62,6 +62,19 @@ def test_default_targets_cover_the_pallas_kernel_modules():
                if p.name.startswith("_pallas_"))
 
 
+def test_default_targets_cover_the_resil_layer_and_chaos_cli():
+    """Round 12 extends the surface over factormodeling_tpu/resil/ (the
+    checkpoint module's retry/backoff sleeps and fenced host-IO saves sit
+    exactly where a careless wall-clock window would land) and the chaos
+    CLI rides the existing tools/ glob. Pinned by name so a future move
+    can't silently drop them from the linted surface."""
+    targets = lint_timing.default_targets(REPO)
+    resil = {p.name for p in targets if p.parent.name == "resil"}
+    assert {"faults.py", "policy.py", "checkpoint.py"} <= resil
+    assert "chaos.py" in {p.name for p in targets
+                          if p.parent.name == "tools"}
+
+
 def _lint_snippet(tmp_path, code):
     f = tmp_path / "snippet.py"
     f.write_text(textwrap.dedent(code))

@@ -395,7 +395,8 @@ def test_run_report_spans_counters_cost_and_render(rng, tmp_path):
 def test_streaming_cache_stats_and_report_rows(rng):
     clear_streaming_cache()
     assert streaming_cache_stats() == {"hits": 0, "misses": 0,
-                                       "evictions": 0, "size": 0}
+                                       "evictions": 0, "size": 0,
+                                       "capacity": 16}
     stack = jnp.asarray(rng.normal(size=(4, 20, 12)).astype(np.float32))
     rets = jnp.asarray(rng.normal(size=(20, 12)).astype(np.float32))
     source = lambda i: stack[2 * i:2 * i + 2]  # noqa: E731

@@ -1,0 +1,695 @@
+"""The resilience layer: elision, inert defaults, fault injection with
+watchdog attribution, degradation policy, checkpoint/resume.
+
+The load-bearing guarantees, in order of importance:
+
+1. **Structural elision** — with no FaultSpec and no DegradePolicy, the
+   research step must be INDISTINGUISHABLE from a build that never had the
+   resil layer. Proven the strong way: the default path traces, compiles,
+   and reproduces its bits with ``factormodeling_tpu.resil`` made
+   UNIMPORTABLE — the pre-PR build is literally "the resil layer does not
+   exist", and the default trace cannot tell the difference.
+2. **Inert defaults** — ``FaultSpec.off()`` + ``DegradePolicy.make()``
+   trace the full resilience subgraph yet reproduce the clean outputs
+   bit-identically (all-False ``jnp.where`` masks select the original
+   operands exactly), so one compiled executable serves a whole chaos
+   matrix including its own baseline.
+3. **Watchdog attribution** — every fault class, at every boundary it can
+   target, is named by the PR 4 watchdog at exactly the stage where it
+   manifests (value faults at their injected stage, staleness at the
+   day-over-day canary, universe collapse at the blend).
+4. **Checkpoint trust** — resume is bit-equal to straight-through, config
+   mismatches are refused, and corruption (bit flip, truncation, version
+   skew) is REJECTED, never half-loaded.
+"""
+
+import io
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu import obs, resil
+from factormodeling_tpu.backtest.settings import SimulationSettings
+from factormodeling_tpu.obs import probes as obs_probes
+from factormodeling_tpu.parallel import (
+    build_research_step,
+    checkpointed_manager_sweep,
+    clear_streaming_cache,
+    combo_weight_matrix,
+    manager_sweep,
+    set_kernel_cache_size,
+    streamed_factor_stats,
+    streaming_cache_stats,
+)
+from factormodeling_tpu.resil import checkpoint as resil_ckpt
+from factormodeling_tpu.resil import policy as resil_policy
+
+NAMES = ("mom_flx", "val_flx", "qual_long", "size_short")
+F, D, N = len(NAMES), 48, 20
+WINDOW = 8
+
+
+def make_inputs(rng, nan_frac=0.04):
+    factors = rng.normal(size=(F, D, N)).astype(np.float32)
+    factors[rng.uniform(size=factors.shape) < nan_frac] = np.nan
+    returns = rng.normal(scale=0.02, size=(D, N)).astype(np.float32)
+    factor_ret = rng.normal(scale=0.01, size=(D, F)).astype(np.float32)
+    cap = rng.integers(1, 4, size=(D, N)).astype(np.float32)
+    inv = np.ones((D, N), np.float32)
+    uni = rng.uniform(size=(D, N)) > 0.05
+    return tuple(jnp.asarray(a)
+                 for a in (factors, returns, factor_ret, cap, inv, uni))
+
+
+def _leaves_bytes(tree):
+    return [np.asarray(leaf).tobytes()
+            for leaf in jax.tree_util.tree_leaves(tree)]
+
+
+def _strip(out):
+    """Drop the structurally-optional leaves so faulted/clean builds
+    compare like-for-like: counters, probes, and the engine's HoldStats."""
+    return out._replace(counters=None, probes=None,
+                        sim=out.sim._replace(degrade=None))
+
+
+# --------------------------------------------------------------- elision
+
+
+def test_default_path_is_a_build_without_the_resil_layer(rng):
+    """The strong form of the PR 2/4 elision idiom: un-import
+    ``factormodeling_tpu.resil`` and make any import attempt raise — the
+    default research step must still trace, lower, and reproduce its bits
+    exactly. The "pre-PR build" of the acceptance criterion IS the build
+    in which the resil layer cannot be imported; if the default path
+    touched it anywhere (pipeline, engine, counters), this would explode
+    rather than merely differ."""
+    args = make_inputs(rng)
+    step = build_research_step(names=NAMES, window=WINDOW,
+                               collect_counters=True)
+    baseline = jax.jit(step)(*args)
+    hlo_before = jax.jit(step).lower(*args).compile().as_text()
+
+    banned = {k: sys.modules.pop(k) for k in list(sys.modules)
+              if k.startswith("factormodeling_tpu.resil")}
+    # None in sys.modules makes ANY "import factormodeling_tpu.resil"
+    # (or from-import of its submodules) raise ImportError immediately
+    sys.modules["factormodeling_tpu.resil"] = None
+    try:
+        step2 = build_research_step(names=NAMES, window=WINDOW,
+                                    collect_counters=True)
+        out = jax.jit(step2)(*args)
+        hlo_banned = jax.jit(step2).lower(*args).compile().as_text()
+    finally:
+        del sys.modules["factormodeling_tpu.resil"]
+        sys.modules.update(banned)
+
+    assert hlo_banned == hlo_before  # HLO-identical to the resil-less build
+    assert _leaves_bytes(out) == _leaves_bytes(baseline)
+    assert baseline.sim.degrade is None
+    # the degrade counters exist (schema stability) but report zeros
+    for field in ("quarantined_days", "held_days", "carry_fallback_days",
+                  "clamped_cells", "degrade_events"):
+        assert int(getattr(baseline.counters, field)) == 0
+
+
+def test_off_spec_and_default_policy_are_bit_inert(rng):
+    """FaultSpec.off() + DegradePolicy.make() trace the full resilience
+    subgraph (different HLO — that is the point: one executable for the
+    whole chaos matrix) yet must reproduce the clean outputs to the bit:
+    all-False masks select the original operands exactly."""
+    args = make_inputs(rng)
+    step = jax.jit(build_research_step(names=NAMES, window=WINDOW))
+    clean = step(*args)
+    inert = step(*args, fault_spec=resil.FaultSpec.off(),
+                 policy=resil.DegradePolicy.make())
+    assert _leaves_bytes(_strip(clean)) == _leaves_bytes(_strip(inert))
+    # the policy side alone must be inert too (the engine's hold pass
+    # runs whenever a policy is present)
+    pol_only = step(*args, policy=resil.DegradePolicy.make())
+    assert _leaves_bytes(_strip(clean)) == _leaves_bytes(_strip(pol_only))
+    assert int(pol_only.sim.degrade.held_days) == 0
+    assert int(pol_only.sim.degrade.carry_days) == 0
+
+
+def test_equal_specs_corrupt_identical_cells(rng):
+    """Determinism: two runs under EQUAL specs are bit-identical; a
+    different seed moves the corruption."""
+    args = make_inputs(rng)
+    step = jax.jit(build_research_step(names=NAMES, window=WINDOW))
+    spec = resil.FaultSpec.single("nan_burst", rate=0.05, seed=7)
+    a = step(*args, fault_spec=spec)
+    b = step(*args, fault_spec=resil.FaultSpec.single("nan_burst",
+                                                      rate=0.05, seed=7))
+    assert _leaves_bytes(_strip(a)) == _leaves_bytes(_strip(b))
+    c = step(*args, fault_spec=resil.FaultSpec.single("nan_burst",
+                                                      rate=0.05, seed=8))
+    assert _leaves_bytes(_strip(a)) != _leaves_bytes(_strip(c))
+
+
+# ------------------------------------------------- watchdog attribution
+
+
+@pytest.fixture(scope="module")
+def probed_step():
+    return jax.jit(build_research_step(names=NAMES, window=WINDOW,
+                                       collect_probes=True))
+
+
+@pytest.fixture(scope="module")
+def clean_profile(probed_step):
+    # NaN-free panels: a stale day re-serving its (NaN-bearing)
+    # predecessor would move ops/factors_raw's finite fraction and the
+    # watchdog — correctly, but earlier in trace order than the canary
+    # this matrix pins; a healthy feed is the clean-attribution baseline
+    rng = np.random.default_rng(12345)
+    args = make_inputs(rng, nan_frac=0.0)
+    clean = probed_step(*args, fault_spec=resil.FaultSpec.off())
+    profile = obs_probes.probe_profile(
+        clean.probes,
+        absmax_stages=("ops/factors_raw", "selection/rolling",
+                       "composite/blend"),
+        nonzero_stages=("ops/factors_delta",))
+    return args, profile
+
+
+# (fault class, injected boundary, stage the watchdog must name): value
+# faults manifest at their own boundary; staleness only at the
+# day-over-day canary; universe collapse at the blend, whose finite
+# fraction IS the universe coverage
+ATTRIBUTION = [
+    ("nan_burst", "ops/factors_raw", "ops/factors_raw"),
+    ("nan_burst", "selection/rolling", "selection/rolling"),
+    ("nan_burst", "composite/blend", "composite/blend"),
+    ("inf_spike", "ops/factors_raw", "ops/factors_raw"),
+    ("inf_spike", "selection/rolling", "selection/rolling"),
+    ("outlier", "ops/factors_raw", "ops/factors_raw"),
+    ("outlier", "selection/rolling", "selection/rolling"),
+    ("outlier", "composite/blend", "composite/blend"),
+    ("drop_day", "ops/factors_raw", "ops/factors_raw"),
+    ("drop_day", "selection/rolling", "selection/rolling"),
+    ("stale_repeat", "ops/factors_raw", "ops/factors_delta"),
+    ("universe_collapse", "ops/factors_raw", "composite/blend"),
+]
+
+
+@pytest.mark.parametrize("fault,stage,expect", ATTRIBUTION,
+                         ids=[f"{f}@{s.split('/')[-1]}"
+                              for f, s, _ in ATTRIBUTION])
+def test_watchdog_attributes_the_injected_stage(probed_step, clean_profile,
+                                                fault, stage, expect):
+    args, profile = clean_profile
+    rate = 0.25 if fault in ("stale_repeat", "drop_day",
+                             "universe_collapse") else 0.05
+    spec = resil.FaultSpec.single(fault, stage=stage, rate=rate, seed=3)
+    out = probed_step(*args, fault_spec=spec)
+    verdict = obs_probes.watchdog(out.probes, baseline=profile)
+    assert verdict["first_bad_stage"] == expect, verdict
+
+
+def test_off_spec_is_clean_under_the_watchdog(probed_step, clean_profile):
+    args, profile = clean_profile
+    out = probed_step(*args, fault_spec=resil.FaultSpec.off(seed=99))
+    verdict = obs_probes.watchdog(out.probes, baseline=profile)
+    assert verdict["first_bad_stage"] is None, verdict
+
+
+def test_probe_canary_without_the_fault_harness(rng):
+    """Production staleness monitoring: ``probe_canary=True`` adds the
+    day-over-day canary to a probed build with NO FaultSpec, so a REAL
+    stale feed is detectable without tracing the injection subgraph
+    (``FaultSpec.off()`` would drag the whole 6-class where-chain into
+    the hot path just to get one delta probe)."""
+    args = make_inputs(rng, nan_frac=0.0)
+    step = jax.jit(build_research_step(names=NAMES, window=WINDOW,
+                                       collect_probes=True,
+                                       probe_canary=True))
+    clean = step(*args)
+    assert "ops/factors_delta" in clean.probes
+    profile = obs_probes.probe_profile(
+        clean.probes, nonzero_stages=("ops/factors_delta",))
+    stale = np.asarray(args[0]).copy()
+    stale[:, 20, :] = stale[:, 19, :]    # the feed re-serves day 19
+    out = step(jnp.asarray(stale), *args[1:])
+    verdict = obs_probes.watchdog(out.probes, baseline=profile)
+    assert verdict["first_bad_stage"] == "ops/factors_delta", verdict
+    # and probe_canary=False suppresses it even for a faulted build
+    quiet = jax.jit(build_research_step(names=NAMES, window=WINDOW,
+                                        collect_probes=True,
+                                        probe_canary=False))
+    out2 = quiet(*args, fault_spec=resil.FaultSpec.off())
+    assert "ops/factors_delta" not in out2.probes
+
+
+# ------------------------------------------------------- policy guards
+
+
+def test_quarantine_masks_only_the_bad_day(rng):
+    factors = jnp.asarray(rng.normal(size=(F, 12, N)).astype(np.float32))
+    factors = factors.at[:, 5, :].set(jnp.nan)   # one fully-NaN date
+    factor_ret = jnp.asarray(rng.normal(size=(12, F)).astype(np.float32))
+    pol = resil.DegradePolicy.make(quarantine_nan_frac=0.5)
+    qday = resil_policy.quarantine_days(factors, None, pol)
+    assert np.asarray(qday).tolist() == [i == 5 for i in range(12)]
+    f_sel, fr_sel = resil_policy.quarantine_inputs(factors, factor_ret, qday)
+    assert bool(jnp.isnan(f_sel[:, 5]).all())
+    assert bool(jnp.isnan(fr_sel[5]).all())
+    # every other date untouched, to the bit
+    keep = np.arange(12) != 5
+    assert (np.asarray(f_sel)[:, keep].tobytes()
+            == np.asarray(factors)[:, keep].tobytes())
+    # the default threshold (> 1) quarantines nothing, even a 100%-NaN day
+    q0 = resil_policy.quarantine_days(factors, None,
+                                      resil.DegradePolicy.make())
+    assert not bool(q0.any())
+
+
+def test_quarantine_counts_in_universe_cells_only(rng):
+    factors = jnp.asarray(rng.normal(size=(F, 6, N)).astype(np.float32))
+    uni = np.ones((6, N), bool)
+    uni[2, N // 2:] = False
+    # day 2: NaN exactly the OUT-of-universe cells — in-universe share 0
+    factors = factors.at[:, 2, N // 2:].set(jnp.nan)
+    pol = resil.DegradePolicy.make(quarantine_nan_frac=0.1)
+    qday = resil_policy.quarantine_days(factors, jnp.asarray(uni), pol)
+    assert not bool(qday.any())
+
+
+def test_clamp_signal_counts_and_default_identity(rng):
+    sig = rng.normal(size=(10, N)).astype(np.float32)
+    sig[3, 4], sig[3, 5], sig[7, 0] = 50.0, -np.inf, np.nan
+    sig = jnp.asarray(sig)
+    clamped, cells, days = resil_policy.clamp_signal(
+        sig, resil.DegradePolicy.make(clamp_absmax=5.0))
+    assert int(cells) == 2 and int(days) == 1        # NaN passes through
+    assert float(clamped[3, 4]) == 5.0
+    assert float(clamped[3, 5]) == -5.0
+    assert bool(jnp.isnan(clamped[7, 0]))
+    ident, c0, d0 = resil_policy.clamp_signal(sig, resil.DegradePolicy.make())
+    assert int(c0) == 0 and int(d0) == 0
+    assert np.asarray(ident).tobytes() == np.asarray(sig).tobytes()
+
+
+def test_hold_weights_min_universe_and_carry(rng):
+    d = 6
+    w = jnp.asarray(rng.normal(size=(d, N)).astype(np.float32))
+    lc = jnp.full((d,), 3, jnp.int32)
+    sc = jnp.full((d,), 3, jnp.int32)
+    ok = jnp.asarray([True, True, False, True, True, False])
+    uni = jnp.asarray([10, 10, 10, 2, 10, 10], jnp.int32)
+    pol = resil.DegradePolicy.make(min_universe=4, carry_fallback=True)
+    w2, lc2, sc2, stats = resil_policy.hold_weights(w, lc, sc, ok, uni, pol)
+    # day 3 fails min-universe -> holds day 2's book, which itself carried
+    # day 1 (day 2's solve failed): the carried chain is the TRADED book
+    assert np.asarray(w2[2]).tobytes() == np.asarray(w2[1]).tobytes()
+    assert np.asarray(w2[3]).tobytes() == np.asarray(w2[2]).tobytes()
+    assert np.asarray(w2[5]).tobytes() == np.asarray(w2[4]).tobytes()
+    # untouched days keep their own solves bitwise
+    for i in (0, 1, 4):
+        assert np.asarray(w2[i]).tobytes() == np.asarray(w[i]).tobytes()
+    assert int(stats.held_days) == 1 and int(stats.carry_days) == 2
+    # leg counts recounted on held days only
+    assert int(lc2[3]) == int((np.asarray(w2[3]) > 0).sum())
+    assert int(lc2[0]) == 3
+    # day-0 hold has nothing to carry: a flat (zero) day, not garbage
+    ok0 = jnp.asarray([False] + [True] * (d - 1))
+    w3, _, _, st3 = resil_policy.hold_weights(w, lc, sc, ok0,
+                                              jnp.full((d,), 10, jnp.int32),
+                                              pol)
+    assert float(jnp.abs(w3[0]).sum()) == 0.0
+    assert int(st3.carry_days) == 1
+    # default policy: bitwise identity, zero tallies
+    w4, lc4, sc4, st4 = resil_policy.hold_weights(
+        w, lc, sc, ok, uni, resil.DegradePolicy.make())
+    assert np.asarray(w4).tobytes() == np.asarray(w).tobytes()
+    assert int(st4.held_days) == 0 and int(st4.carry_days) == 0
+
+
+def test_degrade_stats_ride_stage_counters(rng):
+    """A policy that actually engages must show up in StageCounters (and
+    so in summarize_counters -> RunReport -> report_diff's GATE_UP)."""
+    args = make_inputs(rng, nan_frac=0.0)
+    factors = np.asarray(args[0]).copy()
+    factors[:, 10, :] = np.nan                      # one all-NaN date
+    args = (jnp.asarray(factors),) + args[1:]
+    step = jax.jit(build_research_step(names=NAMES, window=WINDOW,
+                                       collect_counters=True))
+    out = step(*args, policy=resil.DegradePolicy.make(
+        quarantine_nan_frac=0.5))
+    c = out.counters
+    assert int(c.quarantined_days) == 1
+    assert int(c.degrade_events) >= 1
+    summary = obs.summarize_counters(c)
+    assert summary["quarantined_days"] == 1
+    assert "degrade_events" in summary
+    json.dumps(summary)
+
+
+# ------------------------------------------------------------ snapshots
+
+
+def _tree(rng):
+    return {"arrays": [rng.normal(size=(3, 4)),
+                       rng.integers(0, 9, size=(5,), dtype=np.int32)],
+            "nested": {"t": (np.float32(1.5), None, "tag"),
+                       "flag": True, "n": 7},
+            "empty": []}
+
+
+def test_snapshot_roundtrip_bit_equal(tmp_path, rng):
+    state = _tree(rng)
+    p = resil.save_snapshot(tmp_path / "s.ckpt", state, meta={"k": "v"})
+    loaded, meta = resil.load_snapshot(p)
+    assert meta == {"k": "v"}
+    assert loaded["nested"]["t"] == (1.5, None, "tag")
+    assert loaded["nested"]["flag"] is True and loaded["nested"]["n"] == 7
+    got, want = loaded["arrays"], state["arrays"]
+    for g, w in zip(got, want):
+        assert g.dtype == np.asarray(w).dtype
+        assert g.tobytes() == np.asarray(w).tobytes()
+    # no tempfile droppings from the atomic write
+    assert [f.name for f in tmp_path.iterdir()] == ["s.ckpt"]
+
+
+def test_snapshot_like_template_rehangs_typed_pytrees(tmp_path):
+    spec = resil.FaultSpec.single("outlier", rate=0.1, seed=5)
+    # typed pytrees snapshot as their LEAVES (the codec is deliberately
+    # pickle-free); ``like=`` re-hangs them on a template's treedef
+    p = resil.save_snapshot(
+        tmp_path / "spec.ckpt",
+        [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(spec)])
+    loaded, _ = resil.load_snapshot(p, like=resil.FaultSpec.off())
+    assert isinstance(loaded, resil.FaultSpec)
+    assert float(loaded.outlier_rate) == pytest.approx(0.1)
+
+
+def test_snapshot_corruption_is_rejected(tmp_path, rng):
+    p = resil.save_snapshot(tmp_path / "s.ckpt", _tree(rng))
+    raw = bytearray(p.read_bytes())
+
+    flipped = bytearray(raw)
+    flipped[-3] ^= 0x40                              # payload bit flip
+    p.write_bytes(bytes(flipped))
+    with pytest.raises(resil.SnapshotCorrupt, match="checksum"):
+        resil.load_snapshot(p)
+
+    p.write_bytes(bytes(raw[:len(raw) // 2]))        # truncated tail
+    with pytest.raises(resil.SnapshotCorrupt):
+        resil.load_snapshot(p)
+
+    p.write_bytes(b"not a snapshot at all")          # garbled magic
+    with pytest.raises(resil.SnapshotCorrupt, match="magic"):
+        resil.load_snapshot(p)
+
+
+def test_snapshot_version_skew_is_rejected(tmp_path, rng, monkeypatch):
+    monkeypatch.setattr(resil_ckpt, "SNAPSHOT_VERSION",
+                        resil_ckpt.SNAPSHOT_VERSION + 1)
+    p = resil_ckpt.save_snapshot(tmp_path / "s.ckpt", _tree(rng))
+    monkeypatch.undo()
+    with pytest.raises(resil.SnapshotCorrupt, match="version"):
+        resil.load_snapshot(p)
+
+
+def test_checkpointer_resume_guards(tmp_path, rng, capsys):
+    ck = resil.Checkpointer(tmp_path / "c.ckpt", every=2)
+    assert ck.resume() is None                       # nothing yet
+    assert ck.maybe_save(0, {"i": 0}) is None        # thinned out
+    assert ck.maybe_save(1, {"i": 1}, meta={"cfg": [1, 2]}) is not None
+    state, meta = ck.resume(expect_meta={"cfg": [1, 2]})
+    assert state == {"i": 1}
+    # config mismatch: warn + start fresh, never resume the wrong run
+    assert ck.resume(expect_meta={"cfg": [9, 9]}) is None
+    assert "different configuration" in capsys.readouterr().err
+    # corruption: raise by default, discard on request
+    path = tmp_path / "c.ckpt"
+    path.write_bytes(path.read_bytes()[:-4])
+    with pytest.raises(resil.SnapshotCorrupt):
+        ck.resume()
+    assert ck.resume(on_corrupt="discard") is None
+    assert "discarding corrupt snapshot" in capsys.readouterr().err
+    with pytest.raises(ValueError):
+        ck.resume(on_corrupt="ignore")
+
+
+def test_io_retry_bounds_and_backoff():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert resil.io_retry(flaky, backoff=0.0) == "ok"
+    assert len(calls) == 3
+
+    def dead():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        resil.io_retry(dead, retries=2, backoff=0.0)
+
+    # no_retry exceptions propagate on the FIRST attempt: a missing
+    # snapshot is a deterministic verdict, not a fault to sleep through
+    # — every fresh checkpointed run resolves resume() via this path
+    attempts = []
+
+    def missing():
+        attempts.append(1)
+        raise FileNotFoundError("never checkpointed")
+
+    with pytest.raises(FileNotFoundError):
+        resil.io_retry(missing, backoff=0.0,
+                       no_retry=(FileNotFoundError,))
+    assert len(attempts) == 1
+    with pytest.raises(FileNotFoundError):
+        resil_ckpt.load_snapshot(Path("/nonexistent/dir/never.ckpt"),
+                                 backoff=10.0)  # immediate, no sleeps
+
+
+# ------------------------------------------- resume-vs-straight-through
+
+
+def test_streaming_checkpoint_resume_bit_equal(tmp_path, rng):
+    stack = rng.normal(size=(6, 24, 10)).astype(np.float32)
+    rets = jnp.asarray(rng.normal(size=(24, 10)).astype(np.float32))
+    n_chunks, width = 3, 2
+
+    def source(i):
+        return jnp.asarray(stack[width * i:width * (i + 1)])
+
+    straight = streamed_factor_stats(source, n_chunks, rets,
+                                     stats=("factor_return", "rank_ic"))
+
+    calls = {"n": 0}
+
+    def dying_source(i):
+        calls["n"] += 1
+        if calls["n"] == 3:                      # die while loading chunk 2
+            raise RuntimeError("simulated crash")
+        return source(i)
+
+    ck = resil.Checkpointer(tmp_path / "stream.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        streamed_factor_stats(dying_source, n_chunks, rets,
+                              stats=("factor_return", "rank_ic"),
+                              checkpoint=ck)
+    # resume completes from the snapshot and matches to the bit
+    resumed = streamed_factor_stats(source, n_chunks, rets,
+                                    stats=("factor_return", "rank_ic"),
+                                    checkpoint=resil.Checkpointer(
+                                        tmp_path / "stream.ckpt"))
+    for k in straight:
+        assert (np.asarray(resumed[k]).tobytes()
+                == np.asarray(straight[k]).tobytes()), k
+
+
+def test_streaming_checkpoint_config_mismatch_starts_fresh(tmp_path, rng,
+                                                           capsys):
+    stack = rng.normal(size=(4, 16, 8)).astype(np.float32)
+    rets = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+
+    def src2(i):
+        return jnp.asarray(stack[2 * i:2 * i + 2])
+
+    ck = resil.Checkpointer(tmp_path / "s.ckpt")
+    streamed_factor_stats(src2, 2, rets, stats=("factor_return",),
+                          checkpoint=ck)
+    # different chunking: the stale snapshot must be refused, and the
+    # result must equal the uncheckpointed run
+    def src4(i):
+        return jnp.asarray(stack[i:i + 1])
+
+    fresh = streamed_factor_stats(src4, 4, rets, stats=("factor_return",),
+                                  checkpoint=resil.Checkpointer(
+                                      tmp_path / "s.ckpt"))
+    assert "different configuration" in capsys.readouterr().err
+    plain = streamed_factor_stats(src4, 4, rets, stats=("factor_return",))
+    assert (np.asarray(fresh["factor_return"]).tobytes()
+            == np.asarray(plain["factor_return"]).tobytes())
+
+    # same shapes, different input CONTENT (a universe mask appears):
+    # the content fingerprint must refuse the snapshot — chunks computed
+    # under different inputs never concatenate into one result
+    ck2 = resil.Checkpointer(tmp_path / "c.ckpt")
+    streamed_factor_stats(src2, 2, rets, stats=("factor_return",),
+                          checkpoint=ck2)
+    uni = jnp.asarray(rng.uniform(size=(16, 8)) > 0.3)
+    streamed_factor_stats(src2, 2, rets, universe=uni,
+                          stats=("factor_return",),
+                          checkpoint=resil.Checkpointer(tmp_path / "c.ckpt"))
+    assert "different configuration" in capsys.readouterr().err
+
+    # same config/panels, REGENERATED source content: the chunk-0
+    # tripwire (one re-read chunk at resume) must refuse the snapshot
+    ck3 = resil.Checkpointer(tmp_path / "t.ckpt")
+    streamed_factor_stats(src2, 2, rets, stats=("factor_return",),
+                          checkpoint=ck3)
+    stack2 = stack.copy()
+    stack2[0] += 1.0
+
+    def src2b(i):
+        return jnp.asarray(stack2[2 * i:2 * i + 2])
+
+    streamed_factor_stats(src2b, 2, rets, stats=("factor_return",),
+                          checkpoint=resil.Checkpointer(tmp_path / "t.ckpt"))
+    assert "different configuration" in capsys.readouterr().err
+
+
+def test_checkpointed_sweep_refuses_different_settings(tmp_path, rng,
+                                                       capsys):
+    """The sweep's guard fingerprints EVERY input: settings' array/float
+    leaves (pct here) and its static fields via the treedef repr (method
+    here) — a same-shaped run differing in either must start fresh, not
+    splice this snapshot's chunks into its output."""
+    import dataclasses
+
+    factors, cw, settings = _sweep_inputs(rng)
+    checkpointed_manager_sweep(factors, cw, settings, combo_batch=2,
+                               chunk_combos=4,
+                               checkpoint=resil.Checkpointer(
+                                   tmp_path / "s.ckpt"))
+    for other in (dataclasses.replace(settings, pct=0.25),
+                  dataclasses.replace(settings, method="linear")):
+        checkpointed_manager_sweep(factors, cw, other, combo_batch=2,
+                                   chunk_combos=4,
+                                   checkpoint=resil.Checkpointer(
+                                       tmp_path / "s.ckpt"))
+        assert "different configuration" in capsys.readouterr().err
+
+
+def test_fingerprint_distinguishes_content_not_just_shape(rng):
+    a = rng.normal(size=(6, 4)).astype(np.float32)
+    b = a.copy()
+    assert resil.fingerprint(a) == resil.fingerprint(b)
+    b[3, 2] += 1.0
+    assert resil.fingerprint(a) != resil.fingerprint(b)
+    # None is its own token, distinct from any array and position-stable
+    assert resil.fingerprint(a, None) != resil.fingerprint(a, a)
+    assert resil.fingerprint(a, None) == resil.fingerprint(a.copy(), None)
+    # dtype participates even at equal bytes-width and values
+    assert (resil.fingerprint(np.zeros(4, np.float32))
+            != resil.fingerprint(np.zeros(4, np.int32)))
+
+
+def _sweep_inputs(rng, n_combos=8):
+    factors = jnp.asarray(rng.normal(size=(F, 24, 12)))
+    returns = rng.normal(scale=0.02, size=(24, 12))
+    settings = SimulationSettings(
+        returns=jnp.asarray(returns),
+        cap_flag=jnp.asarray(rng.integers(1, 4, size=(24, 12)).astype(float)),
+        investability_flag=jnp.asarray(np.ones((24, 12))),
+        method="equal", pct=0.3)
+    combos = rng.integers(0, F, size=(n_combos, 2))
+    return factors, combo_weight_matrix(combos, F), settings
+
+
+def test_checkpointed_sweep_matches_manager_sweep(tmp_path, rng):
+    factors, cw, settings = _sweep_inputs(rng)
+    straight = manager_sweep(factors, cw, settings, combo_batch=2)
+    chunked = checkpointed_manager_sweep(factors, cw, settings,
+                                         combo_batch=2, chunk_combos=3)
+    # chunk_combos rounds up to a combo_batch multiple (3 -> 4) so the
+    # device-side lanes chunk identically: bit-equality, not tolerance
+    for field in straight._fields:
+        assert (np.asarray(getattr(chunked, field)).tobytes()
+                == np.asarray(getattr(straight, field)).tobytes()), field
+
+
+def test_checkpointed_sweep_interrupt_resume_bit_equal(tmp_path, rng,
+                                                       monkeypatch):
+    factors, cw, settings = _sweep_inputs(rng)
+    straight = manager_sweep(factors, cw, settings, combo_batch=2)
+
+    from factormodeling_tpu.parallel import sweep as sweep_mod
+
+    real = sweep_mod._combine_and_pnl
+    calls = {"n": 0}
+
+    def dying(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:                       # die inside chunk 2
+            raise RuntimeError("simulated kill")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(sweep_mod, "_combine_and_pnl", dying)
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        checkpointed_manager_sweep(factors, cw, settings, combo_batch=2,
+                                   chunk_combos=4,
+                                   checkpoint=resil.Checkpointer(
+                                       tmp_path / "sweep.ckpt"))
+    monkeypatch.setattr(sweep_mod, "_combine_and_pnl", real)
+    resumed = checkpointed_manager_sweep(factors, cw, settings,
+                                         combo_batch=2, chunk_combos=4,
+                                         checkpoint=resil.Checkpointer(
+                                             tmp_path / "sweep.ckpt"))
+    for field in straight._fields:
+        assert (np.asarray(getattr(resumed, field)).tobytes()
+                == np.asarray(getattr(straight, field)).tobytes()), field
+
+
+# ------------------------------------------------- kernel cache bounds
+
+
+def test_kernel_cache_cap_and_eviction_order(rng):
+    clear_streaming_cache()
+    prev = set_kernel_cache_size(2)
+    try:
+        stack = rng.normal(size=(2, 12, 8)).astype(np.float32)
+        rets = jnp.asarray(rng.normal(size=(12, 8)).astype(np.float32))
+        src = jnp.asarray(stack)
+
+        def run(shift):
+            streamed_factor_stats(lambda i, _s=shift: src, 1, rets,
+                                  stats=("factor_return",),
+                                  shift_periods=shift)
+
+        # sources are keyed by identity: use distinct configs instead
+        run(1)          # A: miss
+        run(2)          # B: miss
+        stats = streaming_cache_stats()
+        assert stats["capacity"] == 2 and stats["size"] == 2
+        run(1)          # touch A -> B is now least-recent
+        run(3)          # C: miss, evicts B
+        stats = streaming_cache_stats()
+        assert stats["size"] == 2 and stats["evictions"] == 1
+        misses = stats["misses"]
+        run(1)          # A survived (was touched)
+        assert streaming_cache_stats()["misses"] == misses
+        run(2)          # B was evicted: rebuild
+        assert streaming_cache_stats()["misses"] == misses + 1
+        # shrinking the cap evicts immediately, oldest first
+        set_kernel_cache_size(1)
+        stats = streaming_cache_stats()
+        assert stats["size"] == 1 and stats["capacity"] == 1
+        with pytest.raises(ValueError):
+            set_kernel_cache_size(0)
+    finally:
+        set_kernel_cache_size(prev)
+        clear_streaming_cache()

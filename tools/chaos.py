@@ -1,0 +1,362 @@
+"""Chaos matrix: fault classes x degradation policies over the research step.
+
+The executable proof of the resilience layer (docs/architecture.md §18):
+for every (fault class, policy) cell, run the full research step with the
+fault injected (``factormodeling_tpu.resil.faults``) under the policy
+(``resil.policy``) and assert the production invariants —
+
+- **finite outputs**: total log-return, Sharpe inputs, and every traded
+  weight cell are finite;
+- **dollar neutrality**: on active days the long leg sums to +1 and the
+  short leg to -1 within tolerance (so long+short ~ 0);
+- **weight/turnover bounds**: no |weight| above 1 + tol, daily turnover
+  at most 4 + tol (two legs each turning over at most twice);
+- **watchdog attribution**: the PR 4 numerics watchdog, judged against
+  the clean baseline cell's probe profile, names EXACTLY the stage the
+  fault manifests at (``EXPECT_STAGE``: value faults at their injected
+  boundary, staleness at the ``ops/factors_delta`` canary, universe
+  collapse at ``composite/blend`` where membership becomes NaN).
+
+Every cell runs through ONE compiled step — ``FaultSpec``/``DegradePolicy``
+are traced pytrees, and the clean baseline is the zero-rate spec through
+the same executable. Results land as ``kind="degrade"`` RunReport rows
+(plus per-cell DegradeStats counters via ``StageCounters``), and with
+``--checkpoint`` the matrix loop snapshots after every cell
+(``resil.checkpoint``) and resumes bit-equal — kill it mid-run and rerun.
+
+Usage::
+
+    python tools/chaos.py [--shape F,D,N] [--window 8]
+        [--method mvo_turnover] [--faults all|csv] [--policies all|csv]
+        [--rate 0.05] [--day-rate 0.2] [--seed 0] [--tol 0.05]
+        [--report chaos_report.jsonl] [--checkpoint chaos.ckpt] [--json]
+
+Exit codes: 0 = every cell satisfied every invariant; 1 = at least one
+violation (each printed with its cell and invariant); 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+#: where the watchdog must attribute each fault class (module docs; the
+#: per-stage attribution of value faults at OTHER boundaries is exercised
+#: by tests/test_resil.py's per-stage matrix).
+EXPECT_STAGE = {
+    "nan_burst": "ops/factors_raw",
+    "inf_spike": "ops/factors_raw",
+    "outlier": "ops/factors_raw",
+    "stale_repeat": "ops/factors_delta",
+    "drop_day": "ops/factors_raw",
+    "universe_collapse": "composite/blend",
+}
+
+_DAY_CLASSES = ("stale_repeat", "drop_day", "universe_collapse")
+
+#: test hook: die WITHOUT cleanup right after checkpointing this 0-based
+#: cell index — the mid-run-kill case of the resume differential test.
+_DIE_ENV = "_FMT_CHAOS_DIE_AFTER_CELL"
+
+
+def make_inputs(f: int, d: int, n: int, seed: int = 0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    suffixes = ("_eq", "_flx", "_long", "_short")
+    names = tuple(f"fac{i}{suffixes[i % 4]}" for i in range(f))
+    factors = rng.normal(size=(f, d, n)).astype(np.float32)
+    returns = rng.normal(scale=0.02, size=(d, n)).astype(np.float32)
+    factor_ret = rng.normal(scale=0.01, size=(d, f)).astype(np.float32)
+    cap = rng.integers(1, 4, size=(d, n)).astype(np.float32)
+    invest = np.ones((d, n), np.float32)
+    universe = np.ones((d, n), bool)
+    return names, tuple(jnp.asarray(a) for a in
+                        (factors, returns, factor_ret, cap, invest, universe))
+
+
+def build_policies(resil, clean_blend_absmax: float) -> dict:
+    """The named policy presets of the matrix. ``clamp``'s threshold is
+    keyed to the clean run's ``composite/blend`` probe absmax (x8 margin:
+    generous for healthy dispersion, decisive against 10^9 outliers)."""
+    clamp_at = 8.0 * max(clean_blend_absmax, 1e-6)
+    return {
+        "default": resil.DegradePolicy.make(),
+        "guard": resil.DegradePolicy.make(min_universe=4,
+                                          carry_fallback=True,
+                                          quarantine_nan_frac=0.3),
+        "clamp": resil.DegradePolicy.make(clamp_absmax=clamp_at),
+        "full": resil.DegradePolicy.make(min_universe=4,
+                                         carry_fallback=True,
+                                         quarantine_nan_frac=0.3,
+                                         clamp_absmax=clamp_at),
+    }
+
+
+def check_invariants(out, *, tol: float) -> list[str]:
+    """Violated-invariant messages for one cell's ResearchOutput (empty =
+    the cell holds)."""
+    import numpy as np
+
+    bad: list[str] = []
+    diag = out.sim.diagnostics
+    active = np.asarray(diag.active)
+    if not np.isfinite(float(out.summary.total_log_return)):
+        bad.append("total_log_return is not finite")
+    # NaN weight cells are legitimate (pre-trade days, out-of-universe);
+    # Inf never is, and the magnitude bound judges the traded (NaN->0) book
+    w = np.asarray(out.sim.weights)
+    if np.isinf(w).any():
+        bad.append("traded weights contain Inf")
+    traded = np.nan_to_num(w)
+    if np.max(np.abs(traded)) > 1.0 + tol:
+        bad.append(f"|weight| {np.max(np.abs(traded)):.3g} > 1 + {tol}")
+    long_sum = np.asarray(diag.long_sum)[active]
+    short_sum = np.asarray(diag.short_sum)[active]
+    if long_sum.size:
+        # NaN leg sums would sail through every > tol comparison below
+        # (NaN compares False): an active day with a non-finite leg is
+        # itself a violated invariant, judged first and explicitly
+        if not (np.isfinite(long_sum).all() and np.isfinite(short_sum).all()):
+            bad.append("leg sums are not finite on an active day")
+        else:
+            if np.max(np.abs(long_sum - 1.0)) > tol:
+                bad.append(f"long leg sum off by "
+                           f"{np.max(np.abs(long_sum - 1.0)):.3g} > {tol}")
+            if np.max(np.abs(short_sum + 1.0)) > tol:
+                bad.append(f"short leg sum off by "
+                           f"{np.max(np.abs(short_sum + 1.0)):.3g} > {tol}")
+            if np.max(np.abs(long_sum + short_sum)) > 2 * tol:
+                bad.append("dollar neutrality violated on an active day")
+    turnover = np.nan_to_num(np.asarray(out.sim.result.turnover))
+    if np.max(turnover, initial=0.0) > 4.0 + tol:
+        bad.append(f"daily turnover {np.max(turnover):.3g} > 4 + {tol}")
+    return bad
+
+
+def run_chaos(*, shape=(6, 48, 16), window: int = 8,
+              method: str = "mvo_turnover", faults=None, policies=None,
+              rate: float = 0.05, day_rate: float = 0.2, seed: int = 0,
+              tol: float = 0.05, report=None, checkpoint_path=None,
+              checkpoint_every: int = 1, progress=print) -> dict:
+    """Run the matrix; returns a JSON-ready verdict dict (see ``main``).
+    Importable so the tier-1 smoke test shares one in-process compile."""
+    import jax
+    import numpy as np
+
+    from factormodeling_tpu import obs, resil
+    from factormodeling_tpu.obs import probes as obs_probes
+    from factormodeling_tpu.parallel import build_research_step
+
+    f, d, n = shape
+    names, args = make_inputs(f, d, n, seed=seed)
+    faults = list(faults or resil.FAULT_CLASSES)
+    unknown = set(faults) - set(resil.FAULT_CLASSES)
+    if unknown:
+        raise ValueError(f"unknown fault classes {sorted(unknown)}")
+
+    step = build_research_step(
+        names=names, window=window,
+        sim_kwargs=dict(method=method, lookback_period=min(8, d),
+                        max_weight=0.4),
+        collect_counters=True, collect_probes=True)
+    jitted = jax.jit(step)
+
+    rep = report if report is not None else obs.RunReport("chaos")
+    with rep.activate():
+        # rows recorded by THIS call start here: snapshot saves and resume
+        # replacement slice from the mark, so a caller-supplied report's
+        # pre-existing rows are never snapshotted into — or clobbered by —
+        # the matrix's own continuation
+        mark = len(rep.rows)
+        # clean baseline: the zero-rate spec through the SAME executable
+        with rep.span("chaos/baseline") as sp:
+            clean = sp.add(jitted(*args, fault_spec=resil.FaultSpec.off(),
+                                  policy=resil.DegradePolicy.make()))
+        profile = obs_probes.probe_profile(
+            clean.probes, absmax_stages=("ops/factors_raw",
+                                         "selection/rolling",
+                                         "composite/blend"),
+            nonzero_stages=("ops/factors_delta",))
+        blend_absmax = float(profile["composite/blend"]["absmax"])
+        all_policies = build_policies(resil, blend_absmax)
+        policies = list(policies or all_policies)
+        unknown = set(policies) - set(all_policies)
+        if unknown:
+            raise ValueError(f"unknown policies {sorted(unknown)}; valid: "
+                             f"{sorted(all_policies)}")
+
+        cells = [(fk, pk) for fk in faults for pk in policies]
+        done: dict[str, dict] = {}
+        ck = None
+        ck_meta = {"entry": "chaos",
+                   "config": [list(shape), window, method, faults, policies,
+                              float(rate), float(day_rate), int(seed),
+                              # tol participates: snapshotted cell verdicts
+                              # were JUDGED under it — resuming them into a
+                              # stricter run would serve stale oks
+                              float(tol)]}
+        if checkpoint_path is not None:
+            ck = resil.Checkpointer(checkpoint_path, every=checkpoint_every)
+            got = ck.resume(expect_meta=ck_meta)
+            if got is not None:
+                state, _ = got
+                done = {k: json.loads(v) for k, v in state["done"].items()}
+                # REPLACE this run's rows-so-far with the snapshot's
+                # (which start with the killed run's baseline block): the
+                # resumed report is a continuation of the original run,
+                # not a second run with a duplicate baseline appended —
+                # while rows the caller recorded before us stay put
+                rep.rows[mark:] = [json.loads(row)
+                                   for row in state.get("report_rows", [])]
+                progress(f"chaos: resumed {len(done)}/{len(cells)} cells "
+                         f"from {checkpoint_path}")
+
+        die_after = os.environ.get(_DIE_ENV)
+        for idx, (fault, pol_name) in enumerate(cells):
+            cell = f"chaos/{fault}/{pol_name}"
+            if cell in done:
+                continue
+            cell_rate = day_rate if fault in _DAY_CLASSES else rate
+            spec = resil.FaultSpec.single(fault, rate=cell_rate,
+                                          seed=seed + idx)
+            with rep.span(cell) as sp:
+                out = sp.add(jitted(*args, fault_spec=spec,
+                                    policy=all_policies[pol_name]))
+            violations = check_invariants(out, tol=tol)
+            verdict = obs_probes.watchdog(out.probes, baseline=profile)
+            expected = EXPECT_STAGE[fault]
+            if verdict["first_bad_stage"] != expected:
+                violations.append(
+                    f"watchdog attributed {verdict['first_bad_stage']!r}, "
+                    f"expected {expected!r}")
+            c = out.counters
+            degrade = {k: int(getattr(c, k)) for k in
+                       ("quarantined_days", "held_days",
+                        "carry_fallback_days", "clamped_cells",
+                        "degrade_events")}
+            result = {"fault": fault, "policy": pol_name, "ok": not violations,
+                      "violations": violations,
+                      "first_bad_stage": verdict["first_bad_stage"],
+                      "solver_fallback_days": int(c.solver_fallback_days),
+                      **degrade}
+            rep.record(cell, kind="degrade", **result)
+            rep.add_counters(cell, out.counters)
+            done[cell] = result
+            progress(f"{cell}: {'ok' if result['ok'] else 'FAIL'} "
+                     f"(events={degrade['degrade_events']}, "
+                     f"watchdog={verdict['first_bad_stage']})")
+            if ck is not None:
+                ck.maybe_save(
+                    idx, {"done": {k: json.dumps(v, sort_keys=True)
+                                   for k, v in done.items()},
+                          "report_rows": [json.dumps(r, sort_keys=True,
+                                                     default=str)
+                                          for r in rep.rows[mark:]]},
+                    meta=ck_meta)
+                if die_after is not None and idx == int(die_after):
+                    progress(f"chaos: dying after cell {idx} "
+                             f"({_DIE_ENV} test hook)")
+                    os._exit(137)
+
+    failures = {k: v for k, v in done.items() if not v["ok"]}
+    return {"ok": not failures, "cells": len(cells),
+            "failed": sorted(failures),
+            "results": {k: done[k] for k in sorted(done)}}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--shape", default="6,48,16",
+                        help="F,D,N of the synthetic panel (default 6,48,16)")
+    parser.add_argument("--window", type=int, default=8)
+    parser.add_argument("--method", default="mvo_turnover",
+                        choices=("equal", "linear", "mvo", "mvo_turnover"))
+    parser.add_argument("--faults", default="all",
+                        help="comma-separated fault classes, or 'all'")
+    parser.add_argument("--policies", default="all",
+                        help="comma-separated policy presets "
+                             "(default/guard/clamp/full), or 'all'")
+    parser.add_argument("--rate", type=float, default=0.05,
+                        help="per-cell fault probability (value classes)")
+    parser.add_argument("--day-rate", type=float, default=0.2,
+                        help="per-date fault probability (day classes)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--tol", type=float, default=0.05,
+                        help="leg-sum / bound tolerance (default 0.05)")
+    parser.add_argument("--report", default=None,
+                        help="write the RunReport JSONL here")
+    parser.add_argument("--checkpoint", default=None,
+                        help="snapshot the matrix loop here (atomic; "
+                             "rerunning resumes)")
+    parser.add_argument("--checkpoint-every", type=int, default=1)
+    parser.add_argument("--json", action="store_true",
+                        help="emit the verdict as one JSON object")
+    args = parser.parse_args(argv)
+
+    try:
+        shape = tuple(int(v) for v in args.shape.split(","))
+        if len(shape) != 3:
+            raise ValueError("--shape needs exactly F,D,N")
+    except ValueError as e:
+        print(f"chaos: bad --shape {args.shape!r}: {e}", file=sys.stderr)
+        return 2
+
+    import jax
+
+    try:  # prefer CPU when a sitecustomize pinned another platform
+        jax.config.update("jax_platforms",
+                          os.environ.get("JAX_PLATFORMS", "cpu"))
+    except Exception:
+        pass
+
+    from factormodeling_tpu import obs
+
+    rep = obs.RunReport("chaos")
+    faults = None if args.faults == "all" else args.faults.split(",")
+    policies = None if args.policies == "all" else args.policies.split(",")
+    from factormodeling_tpu.resil import SnapshotCorrupt
+
+    try:
+        verdict = run_chaos(
+            shape=shape, window=args.window, method=args.method,
+            faults=faults, policies=policies, rate=args.rate,
+            day_rate=args.day_rate, seed=args.seed, tol=args.tol,
+            report=rep, checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            progress=lambda msg: print(msg, file=sys.stderr))
+    except ValueError as e:
+        print(f"chaos: {e}", file=sys.stderr)
+        return 2
+    except SnapshotCorrupt as e:
+        # REJECTED, never half-resumed: a damaged snapshot must not
+        # silently seed the matrix with wrong cells. Delete it (or point
+        # --checkpoint elsewhere) to start fresh.
+        print(f"chaos: refusing to resume from a corrupt checkpoint: {e}",
+              file=sys.stderr)
+        return 2
+    if args.report:
+        rep.write_jsonl(args.report)
+        print(f"report: {args.report}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(verdict, sort_keys=True))
+    else:
+        for name, res in verdict["results"].items():
+            status = "ok" if res["ok"] else "FAIL " + "; ".join(
+                res["violations"])
+            print(f"{name}: {status}")
+        print(f"chaos: {len(verdict['failed'])} failing cell(s) of "
+              f"{verdict['cells']}")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,7 +222,11 @@ def default_targets(repo_root=None) -> list[Path]:
     micro-benchmark window is most tempting to leave behind, and an
     unfenced one there times the DISPATCH of a kernel whose whole point
     is dispatch-count reduction — both stay under
-    rule A permanently."""
+    rule A permanently. The resil layer joined with the resilience round
+    (round 12): its checkpoint IO deliberately fences (each save is a
+    host transfer) and its retry/backoff sleeps sit next to timing calls
+    — exactly where a careless wall-clock window would land; the chaos
+    CLI rides the tools/ glob."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))
@@ -230,6 +234,7 @@ def default_targets(repo_root=None) -> list[Path]:
             + sorted((pkg / "backtest").glob("*.py"))
             + sorted((pkg / "obs").glob("*.py"))
             + sorted((pkg / "ops").glob("_pallas_*.py"))
+            + sorted((pkg / "resil").glob("*.py"))
             + sorted((pkg / "solvers").glob("*.py")))
 
 

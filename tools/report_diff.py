@@ -23,6 +23,12 @@ Pure stdlib, no jax: the diff logic lives in
 ``factormodeling_tpu/obs/regression.py`` (itself stdlib-only) and is
 loaded standalone by file path, so this tool runs anywhere the JSONLs do —
 same contract as ``tools/trace_report.py``.
+
+Exit codes: 0 = no regression; 1 = regression found; 2 = unusable input —
+a report file that is missing, empty, all-corrupt, or header-only (a run
+that died before recording anything) is named with the reason rather than
+silently gating nothing. Truncated TAILS (a killed run's last line) are
+skipped with a per-line warning and the remaining rows still diff.
 """
 
 from __future__ import annotations
@@ -95,8 +101,28 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     reg = _load_regression()
+    rows = {}
+    for role, path in (("baseline", args.baseline), ("new", args.new)):
+        try:
+            rows[role] = reg.load_jsonl(path)
+        except OSError as e:
+            print(f"report_diff: cannot read {role} report {path!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        # a report with no rows beyond the meta header has NOTHING to gate
+        # — empty file, all lines corrupt, or a run that died before its
+        # first span. Gating against it would silently pass everything
+        # (empty baseline) or compare nothing (empty new); both are a
+        # broken input, not a clean diff.
+        if not any(r.get("kind") != "meta" for r in rows[role]):
+            detail = ("no parseable rows" if not rows[role]
+                      else "only a meta header — the run died before "
+                           "recording anything")
+            print(f"report_diff: {role} report {path!r} is unusable "
+                  f"({detail}); regenerate it before gating", file=sys.stderr)
+            return 2
     result = reg.diff_reports(
-        reg.load_jsonl(args.baseline), reg.load_jsonl(args.new),
+        rows["baseline"], rows["new"],
         wall_ratio=args.wall_ratio, wall_min_s=args.wall_min_s,
         check_wall=not args.no_wall, counter_tol=args.counter_tol,
         finite_tol=args.finite_tol, comms_ratio=args.comms_ratio,

@@ -10,6 +10,13 @@ telemetry), numerics probes, compile telemetry, the placement ledger
 (comms / device memory / sharding lint), cost-analysis estimates, bench
 rows, and plain stage records print in their own sections. Pure
 stdlib — usable on any box that has the JSONL, no jax required.
+
+Exit codes: 0 = rendered (``--strict`` turns unsound spans / sharding-lint
+flags into 1); 2 = unusable input (missing/unreadable file, or no
+parseable rows at all — empty or fully corrupt). A truncated tail — a run
+killed mid-write — is skipped with a file:line warning and the surviving
+rows still render: partial evidence is exactly what a report of a broken
+run is for.
 """
 
 from __future__ import annotations
@@ -63,7 +70,9 @@ def load_rows(paths) -> list[dict]:
 
         def load_jsonl(path):
             rows = []
-            with Path(path).open() as fh:
+            # errors="replace", like the real load_jsonl: undecodable
+            # bytes fail json.loads and skip-with-warning, never raise
+            with Path(path).open(errors="replace") as fh:
                 for lineno, line in enumerate(fh, start=1):
                     line = line.strip()
                     if not line:
@@ -400,7 +409,18 @@ def main(argv=None) -> int:
                              "sharding-lint row is flagged — makes the "
                              "renderer CI-able")
     args = parser.parse_args(argv)
-    rows = load_rows(args.jsonl)
+    try:
+        rows = load_rows(args.jsonl)
+    except OSError as e:
+        print(f"trace_report: cannot read report: {e}", file=sys.stderr)
+        return 2
+    if not rows:
+        # empty or all-corrupt input: render nothing, say why, and exit
+        # deterministically (the per-line warnings above named the corrupt
+        # lines; a partially-truncated report still renders its good rows)
+        print("trace_report: no parseable report rows in "
+              + ", ".join(args.jsonl), file=sys.stderr)
+        return 2
     print(render(rows))
     if args.strict:
         rc = 0
