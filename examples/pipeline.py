@@ -141,6 +141,18 @@ def _mesh_placement_demo(report, say) -> None:
         f"{sum(v.get('count', 0) for v in (total.get('collectives') or {}).values())} "
         f"collectives, ~{float(total.get('bytes_moved', 0.0)):.3g} bytes "
         f"moved, lint {'clean' if verdict and verdict.get('clean') else 'FLAGGED'}")
+    # device-time attribution of one extra execution of the SAME compiled
+    # step: per-obs.stage device seconds on backends whose profiler
+    # traces carry device tracks, an honest skip-with-reason row on this
+    # CPU container (kind="devtime" either way)
+    dt = report.add_devtime("parallel/research_step",
+                            lambda: compiled(*args))
+    if "skipped" in dt:
+        say(f"  devtime: skipped ({dt['skipped']})")
+    else:
+        say(f"  devtime: {dt.get('device_s', 0.0):.4g}s device across "
+            f"{dt.get('device_tracks')} track(s), host overhead "
+            f"{dt.get('host_overhead_frac')}")
 
 
 def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
@@ -180,8 +192,13 @@ def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
 
     import contextlib
 
+    # latency=True (reported runs only): repeated same-name spans — the
+    # per-method selection/composite loops — roll up into per-scope
+    # quantile sketches (kind="latency" rows with count + p50/p99)
+    # instead of one row each
     report = obs.RunReport("examples/pipeline",
-                           meta={"window": window, "decay": decay})
+                           meta={"window": window, "decay": decay},
+                           latency=report_path is not None)
     # activate only when a report was requested: an active report makes the
     # compat sims contribute counters AND (cached per signature) cost-
     # analysis lowerings, which the plain pipeline should not pay for

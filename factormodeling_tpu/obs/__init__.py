@@ -33,6 +33,19 @@ sections 13 and 15):
   ``compiled.memory_analysis()`` footprints as ``kind="memory"`` rows
   and live ``device.memory_stats()`` watermarks sampled at span exits
   (skip-with-reason on backends without them, e.g. CPU).
+- :mod:`~factormodeling_tpu.obs.latency` — latency SLO telemetry:
+  deterministic mergeable log-bucket quantile sketches
+  (``QuantileSketch``), the per-scope ``LatencyRecorder`` threaded
+  through ``RunReport.span`` and every ``instrument_jit`` entry point
+  (``RunReport(latency=True)``), and declarative ``SLOSpec`` budgets
+  whose verdicts ride the ``kind="latency"`` rows so
+  ``tools/report_diff.py`` exits 1 on a violation.
+- :mod:`~factormodeling_tpu.obs.devtime` — profiler device-time
+  attribution: one programmatic ``jax.profiler`` trace around one
+  instrumented step, device-op durations attributed to the
+  ``obs.stage`` scopes as ``kind="devtime"`` rows
+  (``RunReport.add_devtime``), with an honest skip-with-reason ladder
+  on backends whose traces carry no device tracks (CPU).
 - :mod:`~factormodeling_tpu.obs.report` — ``obs.span(...)`` wall timers
   with built-in ``block_until_ready`` fences, and :class:`RunReport`,
   which merges spans, counter summaries, probe frames, compile rows,
@@ -58,7 +71,17 @@ Quickstart::
     rep.write_jsonl("run_report.jsonl")
 """
 
-from factormodeling_tpu.obs import comms, memory, regression  # noqa: F401
+from factormodeling_tpu.obs import (  # noqa: F401
+    comms,
+    devtime,
+    memory,
+    regression,
+)
+from factormodeling_tpu.obs.latency import (  # noqa: F401
+    LatencyRecorder,
+    QuantileSketch,
+    SLOSpec,
+)
 from factormodeling_tpu.obs.comms import (  # noqa: F401
     CommsLedger,
     comms_ledger,

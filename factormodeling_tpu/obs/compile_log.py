@@ -34,6 +34,7 @@ library's own entry points are wired: the sharded research step
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -253,7 +254,23 @@ class InstrumentedJit:
 
     def __call__(self, *args, **kwargs) -> Any:
         n0, s0 = _totals["compiles"], _totals["compile_s"]
-        out = self._fn(*args, **kwargs)
+        # latency recording (opt-in per report, RunReport(latency=True)):
+        # a per-call latency must cover compute, not dispatch, so the
+        # recorded window FENCES on the outputs — which makes every
+        # instrumented call synchronous while recording. That is the
+        # point of a latency observation and the cost of opting in; with
+        # no recorder (the default) the call path is untouched (one
+        # global read + getattr).
+        rep = active_report()
+        recorder = getattr(rep, "latency", None) if rep is not None else None
+        if recorder is None:
+            out = self._fn(*args, **kwargs)
+            call_s = None
+        else:
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            call_s = time.perf_counter() - t0
         st = self._stats
         st.calls += 1
         if len(st.signatures) < _MAX_SIGNATURES:
@@ -264,6 +281,11 @@ class InstrumentedJit:
             except Exception:  # exotic args never break the call path
                 st.signatures.add(("unsignable",))
         new = _totals["compiles"] - n0
+        if recorder is not None and call_s is not None and not new:
+            # steady-state calls only: a call that compiled is seconds of
+            # XLA, already told by the compile rows — folding it into the
+            # sketch would poison the serving distribution the SLO gates
+            recorder.observe(self.name, call_s)
         if new:
             st.compiles += new
             st.compile_s += _totals["compile_s"] - s0
@@ -278,7 +300,6 @@ class InstrumentedJit:
             # but, happening outside any wrapped call window, never in
             # per-entry-point counts — it cannot fake a retrace). With
             # comms off (the default) this is one attribute read.
-            rep = active_report()
             if rep is not None and getattr(rep, "comms", False):
                 rep.add_placement(
                     self.name, self._fn, *args,

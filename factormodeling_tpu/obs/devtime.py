@@ -1,0 +1,278 @@
+"""Profiler device-time attribution: where the per-step latency lives.
+
+Every wall number the report layer publishes is HOST time — a fenced
+``time.perf_counter`` window around dispatch + device compute + transfer.
+ROADMAP item 2's remaining levers are *per-day device latency*, and a
+host wall cannot say which ``obs.stage`` scope owns it. This module
+closes that gap the way the placement ledger closed the comms blind spot:
+capture one ``jax.profiler`` trace around one instrumented step,
+programmatically, and attribute the device-op durations in the exported
+Chrome trace back to the named scopes PR 2 already stamps into HLO
+``op_name`` metadata (``obs.stage``) — no profiler UI in the loop.
+
+The contract mirrors :mod:`factormodeling_tpu.obs.memory`: every rung
+that can fail on a given backend degrades to an honest
+**skip-with-reason** instead of raising, and the reason lands in the
+``kind="devtime"`` row so the artifact says *why* there is no
+attribution. The ladder, in order:
+
+1. ``jax.profiler.start_trace`` unavailable/raises (another trace is
+   live, profiler not built in) — skipped, reason quoted;
+2. no ``*.trace.json.gz`` exported under the trace dir;
+3. the trace exports but cannot be parsed;
+4. the trace parses but carries **no device tracks** — the CPU backend
+   exports only ``/host:CPU`` threads (measured on this container), so
+   CPU runs skip here with the backend named. This is the honest
+   outcome on the tier-1 container; the attribution path itself is
+   pinned by a synthetic-trace unit test (``tests/test_devtime.py``)
+   and goes live unchanged on a TPU/GPU backend whose traces carry
+   ``/device:*`` process tracks.
+
+Documented limits (the row is an attribution, not an oracle):
+
+- XLA may hoist/fuse ops across scope boundaries; an op whose metadata
+  carries no known stage lands in the explicit ``unattributed`` bucket
+  (same honesty convention as the comms ledger's).
+- Device tracks measure device-op execution; gaps between ops (dispatch
+  stalls, transfers on other lanes) appear only in
+  ``host_overhead_frac`` = 1 − device_s / wall_s, the serial-critical-
+  path number item 2 needs.
+- The traced call is ONE extra execution of an already-warm step; its
+  wall is recorded in the row and never published as a headline (the
+  profiler adds per-op bookkeeping).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+import time
+
+from factormodeling_tpu.obs.comms import STAGE_SCOPES, _stage_of
+
+__all__ = ["CANONICAL_STAGES", "attribute_events", "capture",
+           "device_tracks", "parse_trace"]
+
+#: the attribution vocabulary: the comms ledger's canonical obs.stage
+#: scopes (ONE list, shared with :mod:`~factormodeling_tpu.obs.comms`,
+#: so the devtime and comms per-stage buckets of one step can never
+#: disagree on what a stage is) plus the probe-only raw-input scope.
+#: Matching uses the ledger's ``_stage_of`` rule: outermost (earliest
+#: position) scope wins, position ties prefer the longest scope (so
+#: ``selection/rolling_metrics`` is never shadowed by its
+#: ``selection/rolling`` prefix).
+CANONICAL_STAGES = ("ops/factors_raw",) + STAGE_SCOPES
+
+
+def parse_trace(path) -> list:
+    """The ``traceEvents`` list of one exported Chrome-format trace
+    (``.trace.json.gz`` or plain ``.json``)."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        doc = json.load(fh)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: no traceEvents list")
+    return events
+
+
+def device_tracks(events) -> dict:
+    """pid -> process name for every DEVICE track in the trace.
+
+    The profiler names each process lane via ``process_name`` metadata
+    events; device lanes are ``/device:TPU:0``-style names. Host lanes
+    (``/host:CPU`` — the only kind the CPU backend exports) are not
+    device tracks: counting their python/dispatch events as "device
+    time" would be exactly the host-wall conflation this module exists
+    to end."""
+    out = {}
+    for e in events:
+        if (e.get("ph") == "M" and e.get("name") == "process_name"
+                and isinstance(e.get("args"), dict)):
+            pname = str(e["args"].get("name", ""))
+            if pname.startswith("/device:"):
+                out[e["pid"]] = pname
+    return out
+
+
+def _aggregate_lanes(events, tracks) -> set:
+    """(pid, tid) of AGGREGATE thread lanes on device tracks — lanes the
+    profiler names "XLA Modules" / "Steps" etc., whose single event spans
+    the whole module execution and overlaps the per-op lane's events.
+    Counting both would double the device seconds (device_s > wall_s,
+    host_overhead_frac clamped to 0), so attribution skips these lanes
+    whenever the pid also carries at least one non-aggregate lane; a pid
+    whose ONLY lanes are aggregates keeps them (coarse attribution beats
+    none, and the module lane still carries the op_name metadata)."""
+    lane_names: dict = {}
+    for e in events:
+        if (e.get("ph") == "M" and e.get("name") == "thread_name"
+                and e.get("pid") in tracks
+                and isinstance(e.get("args"), dict)):
+            lane_names[(e["pid"], e.get("tid"))] = \
+                str(e["args"].get("name", ""))
+    aggregates = set()
+    for pid in tracks:
+        lanes = {k: v for k, v in lane_names.items() if k[0] == pid}
+        agg = {k for k, v in lanes.items()
+               if any(t in v.lower() for t in ("module", "step"))}
+        if agg and len(agg) < len(lanes):
+            aggregates |= agg
+    return aggregates
+
+
+def _event_text(event) -> str:
+    """The searchable metadata of one op event: its display name plus
+    every string arg (XLA puts the annotated ``op_name`` path —
+    ``jit_step/selection/rolling/fusion.3`` — in one of these,
+    backend-version dependent)."""
+    parts = [str(event.get("name", ""))]
+    args = event.get("args")
+    if isinstance(args, dict):
+        parts.extend(str(v) for v in args.values())
+    return "\n".join(parts)
+
+
+def attribute_events(events, stages=CANONICAL_STAGES) -> dict:
+    """Attribute device-op durations to named stages.
+
+    Complete (``ph == "X"``) events on device tracks contribute their
+    ``dur`` (microseconds) to the comms ledger's ``_stage_of`` match on
+    their metadata text — outermost scope wins, longest on ties — or to
+    ``unattributed`` when no known stage appears. Aggregate lanes
+    ("XLA Modules"/"Steps") are excluded when an op-level lane exists on
+    the same pid — their module-spanning events overlap the per-op
+    events and would double-count the device seconds
+    (:func:`_aggregate_lanes`). Returns ``{"device_s": total,
+    "per_stage": {stage: seconds}, "unattributed_s": seconds,
+    "device_tracks": n}`` (seconds, not µs)."""
+    tracks = device_tracks(events)
+    skip_lanes = _aggregate_lanes(events, tracks)
+    per_stage: dict[str, float] = {}
+    unattributed = 0.0
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in tracks \
+                or (e.get("pid"), e.get("tid")) in skip_lanes:
+            continue
+        dur_s = float(e.get("dur", 0.0)) * 1e-6
+        if dur_s <= 0.0:
+            continue
+        total += dur_s
+        stage = _stage_of(_event_text(e), stages)
+        if stage == "unattributed":
+            unattributed += dur_s
+        else:
+            per_stage[stage] = per_stage.get(stage, 0.0) + dur_s
+    return {"device_s": total, "per_stage": per_stage,
+            "unattributed_s": unattributed, "device_tracks": len(tracks)}
+
+
+def _trace_files(trace_dir) -> set:
+    paths = glob.glob(os.path.join(str(trace_dir), "**",
+                                   "*.trace.json.gz"), recursive=True)
+    paths += glob.glob(os.path.join(str(trace_dir), "**", "*.trace.json"),
+                       recursive=True)
+    return set(paths)
+
+
+def _newest_trace(trace_dir, exclude=frozenset()) -> "str | None":
+    """The newest trace export under ``trace_dir`` that is not in
+    ``exclude`` — the files present BEFORE this capture started. A kept
+    ``trace_dir`` is reusable across captures, and without the exclusion
+    a capture whose profiler exported nothing (skip rung 2) would
+    silently attribute the PREVIOUS capture's trace under the new name.
+    Files that vanish between the glob and the stat (an external cleanup
+    rotating a kept trace_dir) rank last instead of raising — capture's
+    never-raises contract covers the stat, not just the parse."""
+    def mtime(p):
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return float("-inf")
+
+    paths = _trace_files(trace_dir) - set(exclude)
+    newest = max(paths, key=mtime) if paths else None
+    return newest if newest is not None and mtime(newest) > float("-inf") \
+        else None
+
+
+def capture(fn, *args, stages=CANONICAL_STAGES, trace_dir=None,
+            **kwargs) -> dict:
+    """Trace ONE fenced execution of ``fn(*args, **kwargs)`` and
+    attribute its device time (module docs). Returns the summary dict —
+    either ``{"wall_s", "device_s", "per_stage", "unattributed_s",
+    "host_overhead_frac", "device_tracks", "trace_path"}`` or
+    ``{"skipped": reason, "wall_s": ...}`` from the skip ladder. Never
+    raises on profiler/backend trouble (``fn``'s own exceptions
+    propagate — a crashed step is the caller's news, not this module's).
+
+    ``trace_dir=None`` (default) captures into a temp dir deleted after
+    parsing; pass a path to keep the raw trace next to the report."""
+    import jax
+
+    keep = trace_dir is not None
+    tdir = str(trace_dir) if keep else tempfile.mkdtemp(prefix="fm_devtime_")
+    backend = jax.devices()[0].platform
+    # exports already present (a kept trace_dir reused across captures):
+    # never attributable to THIS capture
+    preexisting = _trace_files(tdir) if keep else frozenset()
+    started = False
+    try:
+        try:
+            jax.profiler.start_trace(tdir)
+            started = True
+        except Exception as e:
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            return {"skipped": f"profiler unavailable: {e}",
+                    "wall_s": round(time.perf_counter() - t0, 6)}
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        wall = time.perf_counter() - t0
+        try:
+            jax.profiler.stop_trace()
+            started = False
+        except Exception as e:  # pragma: no cover - backend quirk
+            started = False
+            return {"skipped": f"profiler stop_trace failed: {e}",
+                    "wall_s": round(wall, 6)}
+        path = _newest_trace(tdir, exclude=preexisting)
+        if path is None:
+            return {"skipped": f"no trace exported under {tdir}",
+                    "wall_s": round(wall, 6)}
+        try:
+            events = parse_trace(path)
+        except Exception as e:
+            return {"skipped": f"trace unparseable: {e}",
+                    "wall_s": round(wall, 6)}
+        attr = attribute_events(events, stages)
+        if attr["device_tracks"] == 0:
+            return {"skipped":
+                    f"no device tracks in the exported trace (backend "
+                    f"'{backend}' exposes host threads only)",
+                    "wall_s": round(wall, 6)}
+        frac = max(0.0, 1.0 - attr["device_s"] / wall) if wall > 0 else None
+        return {"wall_s": round(wall, 6),
+                "device_s": round(attr["device_s"], 6),
+                "per_stage": {k: round(v, 6)
+                              for k, v in sorted(attr["per_stage"].items())},
+                "unattributed_s": round(attr["unattributed_s"], 6),
+                "host_overhead_frac": (round(frac, 6)
+                                       if frac is not None else None),
+                "device_tracks": attr["device_tracks"],
+                "trace_path": path if keep else None}
+    finally:
+        if started:  # fn raised mid-trace: close the profiler session
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        if not keep:
+            shutil.rmtree(tdir, ignore_errors=True)

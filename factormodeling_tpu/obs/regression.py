@@ -41,6 +41,27 @@ flags):
 - **sharding** — a lint row that is no longer ``clean`` (or whose flag
   count grew) against a clean baseline is a regression: XLA started
   replicating or resharding something it didn't before.
+- **latency** (quantile sketches, PR 9) — every baseline latency scope
+  must still exist; per-scope p50/p99 may not exceed ``wall_ratio`` x
+  baseline (same 1.5x / ``--no-wall`` / cross-backend conventions as
+  spans). The noise floor is count-aware: sketches under 100
+  observations keep the span ``wall_min_s`` floor (a near-single-shot
+  wall is mostly scheduler noise), while well-populated sketches —
+  the 503-sample millisecond per-date advance baseline — gate down to
+  1 ms. An ``slo_violated`` latency row in the NEW report is
+  a regression REGARDLESS of wall gating: the SLO is the run's own
+  declared budget, not a machine comparison (a pre-existing baseline
+  violation is noted in the detail but does not excuse the new one).
+- **devtime** — a baseline ``stage="total"`` device-time row (attribution
+  or honest skip) that vanished is a schema regression; per-stage
+  device-second drift is informational (device clocks gate via the SLO/
+  latency artifacts, not via one traced execution).
+- **bench** — bench rows are invocation-dependent (configs are selected
+  per run), so presence is never gated; but a seconds-valued bench row
+  present in both reports gates its value at ``wall_ratio`` — against
+  ``max(baseline value, baseline spread max)`` when the baseline carries
+  a ``spread`` (best-of-N min/max), so a documented container-speed
+  swing absorbs into the gate instead of crying wolf.
 
 Deliberately **pure stdlib** with no package-relative imports:
 ``tools/report_diff.py`` loads this file standalone (importlib by path) so
@@ -56,9 +77,10 @@ import sys
 from collections import defaultdict
 from pathlib import Path
 
-__all__ = ["DiffResult", "Finding", "GATE_UP", "comms_rows",
-           "counter_scalars", "diff_reports", "load_jsonl", "memory_rows",
-           "meta_row", "numerics_baseline", "sharding_rows", "span_totals"]
+__all__ = ["DiffResult", "Finding", "GATE_UP", "bench_rows", "comms_rows",
+           "counter_scalars", "devtime_rows", "diff_reports",
+           "latency_rows", "load_jsonl", "memory_rows", "meta_row",
+           "numerics_baseline", "sharding_rows", "span_totals"]
 
 #: counter keys whose INCREASE is a regression (everything else drifts
 #: informationally). Nested mean/max counters gate on their "mean" leaf.
@@ -210,6 +232,26 @@ def sharding_rows(rows) -> dict:
     """name -> last sharding-lint row."""
     return {r.get("name", ""): r for r in rows
             if r.get("kind") == "sharding"}
+
+
+def latency_rows(rows) -> dict:
+    """name -> last latency-sketch row (kind="latency")."""
+    return {r.get("name", ""): r for r in rows
+            if r.get("kind") == "latency"}
+
+
+def devtime_rows(rows) -> dict:
+    """(name, stage) -> last device-time row (kind="devtime"); error rows
+    — capture failures — are excluded from gating, skip rows are not
+    (an honest skip is part of the schema a baseline pins)."""
+    return {(r.get("name", ""), r.get("stage", "")): r for r in rows
+            if r.get("kind") == "devtime" and "error" not in r}
+
+
+def bench_rows(rows) -> dict:
+    """name -> last bench row (kind="bench", keyed by metric name)."""
+    return {r.get("metric", r.get("name", "")): r for r in rows
+            if r.get("kind") == "bench"}
 
 
 # ------------------------------------------------------------------ diff
@@ -474,5 +516,95 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
         findings.append(Finding(
             "sharding", name, "sharding-lint row present in baseline, "
             "missing in new report", regression=True))
+
+    # ---- latency sketches: presence + p50/p99 ratio (wall conventions),
+    # and SLO verdicts (the run's own declared budgets — gated even when
+    # wall gating is off, since a budget is not a machine comparison)
+    base_lat, new_lat = latency_rows(base_rows), latency_rows(new_rows)
+    for name, base_row in sorted(base_lat.items()):
+        new_row = new_lat.get(name)
+        if new_row is None:
+            findings.append(Finding(
+                "latency", name, "latency row present in baseline, "
+                "missing in new report", regression=True))
+            continue
+        if not check_wall:
+            continue
+        # the span floor exists because a SINGLE-SHOT tiny wall is mostly
+        # scheduler noise — but a quantile backed by many observations is
+        # stable well below it (the per-date advance baseline is a
+        # 503-sample millisecond sketch, exactly the distribution this
+        # gate exists for), so well-populated sketches gate down to 1 ms
+        floor = (wall_min_s if int(base_row.get("count", 0)) < 100
+                 else min(wall_min_s, 1e-3))
+        for key, label in (("p50_s", "p50"), ("p99_s", "p99")):
+            b, n = base_row.get(key), new_row.get(key)
+            if not isinstance(b, (int, float)) \
+                    or not isinstance(n, (int, float)) or b < floor:
+                continue
+            ratio = n / b if b > 0 else float("inf")
+            if ratio > wall_ratio:
+                findings.append(Finding(
+                    "latency", f"{name}/{label}",
+                    f"{label} {b:.6g}s -> {n:.6g}s ({ratio:.2f}x > "
+                    f"{wall_ratio:g}x tolerance)", regression=True))
+    for name in sorted(set(new_lat) - set(base_lat)):
+        findings.append(Finding(
+            "latency", name, "latency scope absent from baseline (new "
+            "or renamed) — re-baseline to gate it"))
+    for name, new_row in sorted(new_lat.items()):
+        if not new_row.get("slo_violated"):
+            continue
+        pre = ("; the baseline violated it too — the SLO gate is "
+               "absolute, fix or re-budget"
+               if (base_lat.get(name) or {}).get("slo_violated") else "")
+        findings.append(Finding(
+            "latency", f"{name}/slo",
+            f"SLO violated: {new_row.get('slo_quantile')}-quantile "
+            f"{new_row.get('slo_observed_s')}s > budget "
+            f"{new_row.get('slo_budget_s')}s "
+            f"(scope {new_row.get('slo_scope')!r}){pre}",
+            regression=True))
+
+    # ---- devtime: the total/skip row is schema, per-stage drift is news
+    base_dt, new_dt = devtime_rows(base_rows), devtime_rows(new_rows)
+    for (name, stg), base_row in sorted(base_dt.items()):
+        if (name, stg) in new_dt:
+            continue
+        findings.append(Finding(
+            "devtime", f"{name}/{stg}",
+            "device-time row present in baseline, missing in new report",
+            regression=(stg == "total")))
+
+    # ---- bench rows: seconds-valued rows gate at wall_ratio against the
+    # spread-aware baseline; presence never gates (configs are selected
+    # per invocation)
+    if check_wall:
+        base_b, new_b = bench_rows(base_rows), bench_rows(new_rows)
+        for name in sorted(set(base_b) & set(new_b)):
+            base_row, new_row = base_b[name], new_b[name]
+            if base_row.get("unit", "s") != "s" \
+                    or new_row.get("unit", "s") != "s":
+                continue
+            b, n = base_row.get("value"), new_row.get("value")
+            if not isinstance(b, (int, float)) \
+                    or not isinstance(n, (int, float)) or b < wall_min_s:
+                continue
+            spread = base_row.get("spread") or {}
+            smax = spread.get("max_s")
+            eff = max(b, smax) if isinstance(smax, (int, float)) else b
+            if n > wall_ratio * eff:
+                findings.append(Finding(
+                    "bench", name,
+                    f"value {b:.6g}s -> {n:.6g}s ({n / b:.2f}x; exceeds "
+                    f"{wall_ratio:g}x even against the baseline spread "
+                    f"max {eff:.6g}s)", regression=True))
+            elif n > wall_ratio * b:
+                findings.append(Finding(
+                    "bench", name,
+                    f"value {b:.6g}s -> {n:.6g}s ({n / b:.2f}x) — within "
+                    f"the baseline's recorded best-of-N spread (max "
+                    f"{eff:.6g}s), so judged run-to-run swing, not a "
+                    f"regression"))
 
     return DiffResult(findings=findings, first_bad_stage=first_bad)

@@ -28,7 +28,9 @@ __all__ = ["RunReport", "SCHEMA_VERSION", "SpanHandle", "active_report",
 #: header row. Bump when row kinds/fields change incompatibly;
 #: ``tools/report_diff.py`` refuses to gate mismatched versions.
 #: 3 = PR 5: meta header + comms/memory/sharding placement-ledger rows.
-SCHEMA_VERSION = 3
+#: 4 = PR 9: latency/devtime rows (quantile sketches, SLO verdicts,
+#: device-time attribution) + bench reps/spread fields.
+SCHEMA_VERSION = 4
 
 _ACTIVE: "RunReport | None" = None
 
@@ -80,7 +82,7 @@ class RunReport:
     """
 
     def __init__(self, label: str | None = None, meta: dict | None = None,
-                 *, comms: bool = False):
+                 *, comms: bool = False, latency=False, slos=()):
         self.label = label
         self.meta = dict(meta or {})
         self.rows: list[dict] = []
@@ -91,6 +93,39 @@ class RunReport:
         #: HLO is ever rendered or walked, and the report's rows are
         #: bit-identical to a build without the ledger feature.
         self.comms = bool(comms)
+        #: opt-in latency distributions: ``latency=True`` builds a
+        #: :class:`~factormodeling_tpu.obs.latency.LatencyRecorder` (or
+        #: pass your own recorder to share sketches across reports).
+        #: While set, every :meth:`span` exit folds its fenced wall into
+        #: the scope's quantile sketch (repeated same-name spans roll up
+        #: into the sketch instead of emitting one row each) and every
+        #: ``obs.instrument_jit`` entry point records per-call FENCED
+        #: latency (compiling calls excluded). ``slos`` is a sequence of
+        #: :class:`~factormodeling_tpu.obs.latency.SLOSpec`; matching
+        #: latency rows carry the verdict ``tools/report_diff.py`` /
+        #: ``trace_report.py --strict`` gate on. With latency off (the
+        #: default) nothing in obs.latency is ever called — structural
+        #: elision, pinned in tests/test_latency.py.
+        self.slos = tuple(slos)
+        if latency or self.slos:
+            from factormodeling_tpu.obs.latency import LatencyRecorder
+
+            if isinstance(latency, bool):
+                latency = LatencyRecorder()
+            elif not isinstance(latency, LatencyRecorder):
+                # fail HERE, not as an AttributeError inside the first
+                # span exit's finally block (which would also eat the row)
+                raise TypeError(
+                    f"latency must be a bool or a LatencyRecorder, got "
+                    f"{type(latency).__name__}")
+            self.latency = latency
+        else:
+            self.latency = None
+        self._span_row_names: set = set()
+        #: scope -> max mem_peak_bytes gauge seen across folded span
+        #: exits (incl. suppressed repeats), annotated onto the latency
+        #: rows so the rollup never hides a blown watermark
+        self._span_mem_max: dict = {}
 
     # ------------------------------------------------------------- recording
 
@@ -120,6 +155,19 @@ class RunReport:
         the live device-memory gauges into ``mem_bytes_in_use`` /
         ``mem_peak_bytes``, so the span that blew the HBM watermark is
         identifiable from the report.
+
+        With a latency recorder installed (``RunReport(latency=True)``),
+        every SOUND clean exit (fenced outputs, or a declared
+        ``sync="host"`` window) also feeds the scope's quantile sketch,
+        and REPEATED same-name spans fold into the sketch instead of
+        appending one row each — the first occurrence keeps its span row
+        (presence gating survives); the ``kind="latency"`` row carries
+        count/total/p50/p90/p99/max plus the scope's max device-memory
+        watermark, so a suppressed repeat that blew the HBM high-water
+        mark is still identifiable (at scope, not per-occurrence,
+        granularity; suppressed repeats' ``handle.fields`` are dropped).
+        Unfenced and error rows are neither folded nor suppressed — a
+        dispatch-only or crashed wall is not a latency sample.
         """
         import sys
 
@@ -142,9 +190,41 @@ class RunReport:
                 mem = ({"mem_bytes_in_use": gauges["bytes_in_use"],
                         "mem_peak_bytes": gauges["peak_bytes_in_use"]}
                        if gauges is not None else {})
-                self.record(name, kind="span", wall_s=round(wall, 6),
-                            fenced=bool(handle._outputs) and not raised,
-                            **{**fields, **handle.fields, **mem, **err})
+                # latency rollup (opt-in): every SOUND clean exit feeds
+                # the scope's quantile sketch; REPEATED same-name spans
+                # fold into the sketch instead of appending one row each
+                # (the per-date / per-chunk case that motivated the
+                # sketch). Sound = fenced device outputs or a declared
+                # sync="host" window — the same soundness rule
+                # trace_report's span column applies: an unfenced wall
+                # may have timed dispatch only, and folding it would put
+                # the exact host-wall conflation the sketch exists to
+                # end behind an SLO verdict. Unfenced and error exits
+                # are neither folded nor suppressed (their rows stay
+                # individually visible to --strict), and only a CLEAN
+                # folded row marks the scope as seen — an error on the
+                # first occurrence cannot suppress later clean rows.
+                # Suppressed repeats keep their scope-max device-memory
+                # watermark via latency_rows(); their per-occurrence
+                # handle.fields are dropped (the latency row is the
+                # rollup).
+                sound = (bool(handle._outputs)
+                         or fields.get("sync") == "host"
+                         or handle.fields.get("sync") == "host")
+                fold = self.latency is not None and not raised and sound
+                if fold:
+                    self.latency.observe(name, wall)
+                    if mem:
+                        peak = self._span_mem_max.get(name, 0)
+                        self._span_mem_max[name] = max(
+                            peak, mem["mem_peak_bytes"])
+                suppress = fold and name in self._span_row_names
+                if fold and not suppress:
+                    self._span_row_names.add(name)
+                if not suppress:
+                    self.record(name, kind="span", wall_s=round(wall, 6),
+                                fenced=bool(handle._outputs) and not raised,
+                                **{**fields, **handle.fields, **mem, **err})
 
     def add_counters(self, name: str, counters) -> None:
         """Summarize a :class:`~factormodeling_tpu.obs.counters.StageCounters`
@@ -266,6 +346,56 @@ class RunReport:
         except Exception as e:
             return self.record(name, kind="comms", error=str(e))
 
+    def add_devtime(self, name: str, fn, *args, stages=None,
+                    trace_dir=None, **kwargs) -> dict:
+        """Profiler device-time attribution of ONE extra fenced execution
+        of ``fn(*args, **kwargs)`` (:mod:`factormodeling_tpu.obs.devtime`):
+        per-stage ``kind="devtime"`` rows plus a ``stage="total"`` row
+        carrying the host wall and ``host_overhead_frac``. Backends whose
+        traces carry no device tracks (CPU) record ONE skip row with the
+        reason — the honest ladder, same pattern as the memory rows.
+        Profiler/backend trouble never raises (``capture`` degrades
+        every such rung to a skip internally); ``fn``'s OWN exceptions
+        propagate — a crashed step is the caller's news and must not be
+        mislabeled as profiler trouble. Returns the total/skip row."""
+        from factormodeling_tpu.obs import devtime as _devtime
+
+        kw = {"trace_dir": trace_dir, **kwargs}
+        if stages is not None:
+            kw["stages"] = stages
+        summary = _devtime.capture(fn, *args, **kw)
+        if "skipped" in summary:
+            return self.record(name, kind="devtime", stage="total",
+                               skipped=summary["skipped"],
+                               wall_s=summary.get("wall_s"))
+        for stage, secs in summary["per_stage"].items():
+            self.record(name, kind="devtime", stage=stage, device_s=secs)
+        return self.record(
+            name, kind="devtime", stage="total",
+            device_s=summary["device_s"],
+            unattributed_s=summary["unattributed_s"],
+            wall_s=summary["wall_s"],
+            host_overhead_frac=summary["host_overhead_frac"],
+            device_tracks=summary["device_tracks"],
+            **({"trace_path": summary["trace_path"]}
+               if summary.get("trace_path") else {}))
+
+    def latency_rows(self) -> list:
+        """The recorder's ``kind="latency"`` rows (one per scope, sorted,
+        SLO-judged) — empty with latency off. Derived on demand so the
+        sketches keep accumulating until the report is written. Scopes
+        whose folded spans sampled device-memory gauges carry the max
+        watermark (``mem_peak_bytes_max``) so suppressed repeat rows
+        cannot hide the span that blew it."""
+        if self.latency is None:
+            return []
+        rows = self.latency.rows(self.slos)
+        for row in rows:
+            peak = self._span_mem_max.get(row["name"])
+            if peak is not None:
+                row["mem_peak_bytes_max"] = peak
+        return rows
+
     # ------------------------------------------------------------ lifecycle
 
     @contextmanager
@@ -303,10 +433,11 @@ class RunReport:
                 "mesh_shape": self.meta.get("mesh_shape")}
 
     def all_rows(self) -> list:
-        """Header + recorded rows — what :meth:`write_jsonl` emits; use
-        this (not ``.rows``) when diffing an in-memory report against a
-        written baseline so the meta header participates."""
-        return [self.header()] + self.rows
+        """Header + recorded rows + the latency rollup rows — what
+        :meth:`write_jsonl` emits; use this (not ``.rows``) when diffing
+        an in-memory report against a written baseline so the meta
+        header and latency rows participate."""
+        return [self.header()] + self.rows + self.latency_rows()
 
     def to_dict(self) -> dict:
         return {"label": self.label, "meta": self.meta, "rows": self.rows}

@@ -1,0 +1,250 @@
+"""Device-time attribution (obs/devtime.py): the trace-parsing and
+stage-attribution model on a synthetic Chrome trace (device tracks exist
+only on TPU/GPU backends, so the model is pinned hardware-free), the
+honest skip-with-reason ladder on THIS CPU container, the
+RunReport.add_devtime row shapes, and the trace_report strict validation
+of the new row kinds."""
+
+import gzip
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from factormodeling_tpu import obs
+from factormodeling_tpu.obs import devtime
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO / "tools") not in sys.path:
+    sys.path.insert(0, str(REPO / "tools"))
+
+
+# ----------------------------------------------------- synthetic-trace model
+
+
+def _synthetic_events():
+    """A minimal Chrome trace the jax profiler shape: process_name
+    metadata rows naming the lanes, complete (ph="X") op events with µs
+    durations; the op_name path with obs.stage scopes rides either the
+    display name or a string arg, backend-version dependent — both are
+    exercised."""
+    return [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 8,
+         "args": {"name": "/device:TPU:1"}},
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/host:CPU"}},
+        # stage in a string arg (the long_name convention)
+        {"ph": "X", "pid": 7, "name": "fusion.3", "dur": 1000.0,
+         "args": {"long_name": "jit_step/selection/rolling/reduce.1"}},
+        # stage in the display name itself
+        {"ph": "X", "pid": 7, "name": "jit_step/solver/admm/while.2",
+         "dur": 2500.0},
+        # second device track contributes too
+        {"ph": "X", "pid": 8, "name": "jit_step/solver/admm/while.2",
+         "dur": 500.0},
+        # nested scopes: the OUTERMOST (earliest in the path) wins
+        {"ph": "X", "pid": 7, "dur": 200.0,
+         "name": "jit_step/backtest/pnl/solver/admm/dot.1"},
+        # no known stage -> honest unattributed bucket
+        {"ph": "X", "pid": 7, "name": "copy.17", "dur": 300.0},
+        # host-lane python/dispatch time must NEVER count as device time
+        {"ph": "X", "pid": 1, "name": "PjitFunction(step)", "dur": 9e6},
+        # zero/absent durations are ignored
+        {"ph": "X", "pid": 7, "name": "marker", "dur": 0.0},
+    ]
+
+
+def test_device_tracks_excludes_host_lanes():
+    tracks = devtime.device_tracks(_synthetic_events())
+    assert set(tracks.values()) == {"/device:TPU:0", "/device:TPU:1"}
+
+
+def test_attribution_model_on_synthetic_trace():
+    out = devtime.attribute_events(_synthetic_events())
+    per = out["per_stage"]
+    assert abs(per["selection/rolling"] - 1000e-6) < 1e-12
+    assert abs(per["solver/admm"] - 3000e-6) < 1e-12    # both tracks
+    assert abs(per["backtest/pnl"] - 200e-6) < 1e-12    # outermost scope
+    assert abs(out["unattributed_s"] - 300e-6) < 1e-12
+    assert abs(out["device_s"] - 4500e-6) < 1e-12       # host lane excluded
+    assert out["device_tracks"] == 2
+
+
+def test_attribution_shares_the_comms_ledger_stage_model():
+    """ONE stage vocabulary + matcher with obs/comms: an op inside
+    ``selection/rolling_metrics`` must land in that scope, not be
+    shadowed by its ``selection/rolling`` prefix — the ledger's
+    longest-scope tie-break, so the devtime and comms per-stage buckets
+    of one step can never disagree."""
+    from factormodeling_tpu.obs.comms import STAGE_SCOPES
+
+    assert set(STAGE_SCOPES) < set(devtime.CANONICAL_STAGES)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 7, "dur": 700.0,
+         "name": "jit_step/selection/rolling_metrics/fusion.9"},
+    ]
+    per = devtime.attribute_events(events)["per_stage"]
+    assert per == {"selection/rolling_metrics": 700e-6}
+
+
+def test_aggregate_module_lanes_do_not_double_count():
+    """Real XLA traces carry an 'XLA Modules' lane whose single event
+    spans the whole execution ALONGSIDE the per-op lane: counting both
+    would double device_s (and clamp host_overhead_frac to 0). The
+    aggregate lane is excluded when an op lane exists on the pid; a pid
+    with ONLY an aggregate lane keeps it (coarse beats none)."""
+    both = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 7, "tid": 1,
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "name": "thread_name", "pid": 7, "tid": 2,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 7, "tid": 1, "dur": 3000.0,
+         "name": "jit_step.1"},                       # module-span event
+        {"ph": "X", "pid": 7, "tid": 2, "dur": 1000.0,
+         "name": "jit_step/solver/admm/while.2"},
+        {"ph": "X", "pid": 7, "tid": 2, "dur": 800.0,
+         "name": "jit_step/backtest/pnl/dot.1"},
+    ]
+    out = devtime.attribute_events(both)
+    assert abs(out["device_s"] - 1800e-6) < 1e-12     # ops lane only
+    assert set(out["per_stage"]) == {"solver/admm", "backtest/pnl"}
+
+    only_module = [e for e in both if e.get("tid") != 2]
+    out = devtime.attribute_events(only_module)
+    assert abs(out["device_s"] - 3000e-6) < 1e-12     # kept: sole lane
+
+
+def test_parse_trace_roundtrip_gz(tmp_path):
+    path = tmp_path / "t.trace.json.gz"
+    with gzip.open(path, "wt") as fh:
+        json.dump({"traceEvents": _synthetic_events()}, fh)
+    events = devtime.parse_trace(path)
+    assert devtime.attribute_events(events)["device_tracks"] == 2
+
+
+def test_capture_never_attributes_a_stale_trace_from_a_kept_dir(tmp_path):
+    """A kept trace_dir is reusable across captures; a capture whose
+    profiler exported NOTHING must skip (rung 2), not silently attribute
+    the previous capture's export under the new name."""
+    stale = tmp_path / "old.trace.json.gz"
+    with gzip.open(stale, "wt") as fh:
+        json.dump({"traceEvents": _synthetic_events()}, fh)
+    assert devtime._newest_trace(tmp_path) == str(stale)
+    assert devtime._newest_trace(tmp_path, exclude={str(stale)}) is None
+    # end to end: the CPU capture into the dir holding the stale device
+    # trace must NOT pick it up — on this container the fresh export has
+    # no device tracks, so the verdict must be the device-tracks skip
+    # (stale pickup would "succeed" with the synthetic TPU attribution)
+    f = jax.jit(lambda x: x * 3.0)
+    f(jnp.ones(4)).block_until_ready()
+    summary = devtime.capture(f, jnp.ones(4), trace_dir=tmp_path)
+    assert "skipped" in summary
+    assert "no device tracks" in summary["skipped"]
+
+
+# ------------------------------------------------- the CPU-container ladder
+
+
+def test_capture_skips_with_reason_on_cpu():
+    """THIS container's honest outcome: the profiler exports only
+    /host:CPU lanes, so capture returns a skip naming the backend — and
+    still reports the fenced wall of the sacrificial execution."""
+    f = jax.jit(lambda x: (x * x).sum())
+    x = jnp.ones((64,))
+    f(x).block_until_ready()
+    summary = devtime.capture(f, x)
+    assert "skipped" in summary
+    assert "no device tracks" in summary["skipped"]
+    assert "cpu" in summary["skipped"]
+    assert summary["wall_s"] >= 0.0
+
+
+def test_add_devtime_records_skip_row_and_step_crashes_propagate():
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.ones((8,))
+    f(x).block_until_ready()
+    rep = obs.RunReport("t")
+    row = rep.add_devtime("step", f, x)
+    assert row["kind"] == "devtime" and row["stage"] == "total"
+    assert "no device tracks" in row["skipped"]
+    # profiler/backend trouble is degraded INSIDE capture (the skip
+    # ladder); an exception out of the traced call is the STEP's own
+    # crash and must propagate, not be mislabeled as profiler trouble
+    rep2 = obs.RunReport("t2")
+
+    def broken_step():
+        raise RuntimeError("the step itself crashed")
+
+    import pytest
+
+    with pytest.raises(RuntimeError, match="the step itself crashed"):
+        rep2.add_devtime("step", broken_step)
+    # ... and the crash closed the profiler session (a later capture on
+    # this process still works instead of 'trace already active')
+    assert "skipped" in devtime.capture(f, x)
+
+
+def test_add_devtime_success_rows(monkeypatch):
+    """The device-track path's row shapes, driven through a faked capture
+    (real device tracks need TPU/GPU): one row per stage + the total row
+    with wall/host-overhead."""
+    monkeypatch.setattr(devtime, "capture", lambda fn, *a, **k: {
+        "wall_s": 0.01, "device_s": 0.006,
+        "per_stage": {"selection/rolling": 0.002, "solver/admm": 0.004},
+        "unattributed_s": 0.0, "host_overhead_frac": 0.4,
+        "device_tracks": 1, "trace_path": None})
+    rep = obs.RunReport("t")
+    total = rep.add_devtime("step", lambda: None)
+    rows = [r for r in rep.rows if r["kind"] == "devtime"]
+    assert [r.get("stage") for r in rows] == ["selection/rolling",
+                                             "solver/admm", "total"]
+    assert total["host_overhead_frac"] == 0.4
+    assert total["device_s"] == 0.006 and total["wall_s"] == 0.01
+
+
+# ------------------------------------- strict validation of the new kinds
+
+
+def test_trace_report_strict_validates_new_row_kinds(tmp_path, capsys):
+    import trace_report
+
+    # a violated SLO fails --strict
+    violated = tmp_path / "slo.jsonl"
+    violated.write_text(json.dumps(
+        {"kind": "latency", "name": "svc", "count": 3, "p50_s": 0.2,
+         "p90_s": 0.3, "p99_s": 0.4, "slo_quantile": 0.99,
+         "slo_budget_s": 0.1, "slo_observed_s": 0.4,
+         "slo_violated": True}) + "\n")
+    assert trace_report.main([str(violated), "--strict"]) == 1
+    assert "violated their SLO" in capsys.readouterr().err
+
+    # malformed latency (count without quantiles) and devtime (neither
+    # seconds nor a reason) rows fail --strict
+    malformed = tmp_path / "bad.jsonl"
+    malformed.write_text(
+        json.dumps({"kind": "latency", "name": "svc", "count": 3}) + "\n"
+        + json.dumps({"kind": "devtime", "name": "step",
+                      "stage": "total"}) + "\n")
+    assert trace_report.main([str(malformed), "--strict"]) == 1
+    err = capsys.readouterr().err
+    assert "malformed" in err
+
+    # a healthy latency row + an honest devtime skip row render clean
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text(
+        json.dumps({"kind": "latency", "name": "svc", "count": 2,
+                    "p50_s": 0.1, "p90_s": 0.2, "p99_s": 0.2,
+                    "max_s": 0.2, "total_s": 0.3}) + "\n"
+        + json.dumps({"kind": "devtime", "name": "step", "stage": "total",
+                      "skipped": "no device tracks"}) + "\n")
+    assert trace_report.main([str(ok), "--strict"]) == 0
+    out = capsys.readouterr().out
+    assert "latency sketches" in out and "device time" in out

@@ -1,0 +1,327 @@
+"""Latency SLO telemetry (obs/latency.py + the RunReport/instrument_jit
+threading): sketch determinism + merge associativity + quantile accuracy,
+SLO verdicts, span rollup, per-call entry-point latency, the
+structural-elision contract (latency off -> none of the machinery runs),
+and the bench daily-advance acceptance (a latency row with nonzero count
+and finite p50/p99).
+"""
+
+import math
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # for `import bench`, standalone-run safe
+    sys.path.insert(0, str(REPO))
+
+from factormodeling_tpu import obs
+from factormodeling_tpu.obs.latency import (
+    BUCKETS_PER_OCTAVE,
+    LatencyRecorder,
+    QuantileSketch,
+    SLOSpec,
+)
+
+# ------------------------------------------------------------- the sketch
+
+
+def _samples(n=4000, seed=0):
+    """Deterministic lognormal latencies spanning ~3 decades (µs to ~s) —
+    the shape a mixed dispatch/compute distribution actually has."""
+    rng = np.random.default_rng(seed)
+    return rng.lognormal(mean=-6.0, sigma=1.6, size=n)
+
+
+def test_sketch_is_order_deterministic():
+    xs = _samples()
+    a, b = QuantileSketch(), QuantileSketch()
+    for x in xs:
+        a.add(float(x))
+    for x in reversed(xs):
+        b.add(float(x))
+    assert a.to_row() == b.to_row()
+
+
+def test_sketch_merge_is_associative_and_exact():
+    xs = _samples()
+    whole = QuantileSketch()
+    parts = [QuantileSketch() for _ in range(3)]
+    for i, x in enumerate(xs):
+        whole.add(float(x))
+        parts[i % 3].add(float(x))
+
+    def clone(sk):
+        return QuantileSketch.from_row(sk.to_row())
+
+    left = clone(parts[0]).merge(clone(parts[1])).merge(clone(parts[2]))
+    right = clone(parts[0]).merge(clone(parts[1]).merge(clone(parts[2])))
+    assert left.to_row() == right.to_row() == whole.to_row()
+
+
+def test_sketch_quantiles_within_one_bucket_of_numpy():
+    """The accuracy contract: every quantile estimate is within one
+    log-bucket width (2^(1/8) relative) of np.percentile, and clamped
+    into the exact observed range."""
+    xs = _samples()
+    sk = QuantileSketch()
+    for x in xs:
+        sk.add(float(x))
+    for q in (0.01, 0.1, 0.5, 0.9, 0.99, 0.999):
+        est = sk.quantile(q)
+        true = float(np.percentile(xs, q * 100))
+        # one bucket width in log2, plus epsilon for percentile's
+        # interpolation between order statistics
+        assert abs(math.log2(est / true)) <= 1.0 / BUCKETS_PER_OCTAVE + 0.02, \
+            (q, est, true)
+    assert sk.quantile(0.0) >= sk.min
+    assert sk.quantile(1.0) == sk.max  # exact: clamped to observed max
+
+
+def test_sketch_row_roundtrip_and_geometry_guard():
+    sk = QuantileSketch()
+    for x in (1e-7, 3e-4, 0.02, 0.02, 5.0):  # incl. sub-base underflow
+        sk.add(x)
+    row = sk.to_row()
+    assert QuantileSketch.from_row(row).to_row() == row
+    assert row["count"] == 5 and row["min_s"] == 0.0
+    with pytest.raises(ValueError, match="geometry"):
+        QuantileSketch.from_row({**row, "buckets_per_octave": 4})
+
+
+def test_sketch_rejects_broken_timers_and_empty_quantile():
+    sk = QuantileSketch()
+    for bad in (float("nan"), float("inf"), -1.0):
+        with pytest.raises(ValueError):
+            sk.add(bad)
+    assert sk.count == 0
+    assert math.isnan(sk.quantile(0.5))
+    row = sk.to_row()
+    assert row["count"] == 0 and row["p99_s"] is None
+
+
+# ------------------------------------------------------------------- SLOs
+
+
+def test_slospec_validation_and_matching():
+    with pytest.raises(ValueError):
+        SLOSpec("x", quantile=0.0)
+    with pytest.raises(ValueError):
+        SLOSpec("x", budget_s=0.0)
+    spec = SLOSpec("streaming/*", quantile=0.99, budget_s=0.5)
+    assert spec.matches("streaming/stats")
+    assert not spec.matches("solver/admm")
+
+
+def test_recorder_rows_carry_first_matching_slo_verdict():
+    rec = LatencyRecorder()
+    for t in (0.1, 0.2, 0.9):
+        rec.observe("svc/advance", t)
+    rec.observe("svc/other", 0.01)
+    specs = [SLOSpec("svc/advance", quantile=0.5, budget_s=0.25),
+             SLOSpec("svc/*", quantile=0.99, budget_s=10.0)]
+    rows = {r["name"]: r for r in rec.rows(specs)}
+    # specific spec wins for advance (declaration order), glob for other
+    assert rows["svc/advance"]["slo_quantile"] == 0.5
+    assert rows["svc/advance"]["slo_violated"] is False  # p50 ~0.2 <= 0.25
+    assert rows["svc/other"]["slo_scope"] == "svc/*"
+    assert rows["svc/other"]["slo_violated"] is False
+    # tighten: the p50 budget below the observed median flips the verdict
+    rows = {r["name"]: r
+            for r in rec.rows([SLOSpec("svc/advance", 0.5, 0.05)])}
+    assert rows["svc/advance"]["slo_violated"] is True
+    # names sort deterministically
+    assert [r["name"] for r in rec.rows()] == ["svc/advance", "svc/other"]
+
+
+# ------------------------------------- RunReport span rollup + entry points
+
+
+def test_span_repeats_fold_into_sketch_with_latency_on():
+    """The per-chunk/per-date case: N same-name SOUND spans emit ONE
+    span row (presence gating survives) plus a latency row with count N;
+    distinct names keep their own rows and sketches. Fenced and declared
+    host-synchronous windows both count as sound."""
+    rep = obs.RunReport("t", latency=True)
+    for _ in range(5):
+        with rep.span("streaming/chunk", sync="host"):
+            pass
+    with rep.span("other") as sp:
+        sp.add(jnp.ones((2,)))
+    with rep.span("other") as sp:
+        sp.add(jnp.ones((2,)))
+    spans = [r for r in rep.rows if r["kind"] == "span"]
+    assert [r["name"] for r in spans] == ["streaming/chunk", "other"]
+    lat = {r["name"]: r for r in rep.latency_rows()}
+    assert lat["streaming/chunk"]["count"] == 5
+    assert lat["other"]["count"] == 2
+    # all_rows carries header + rows + the rollup
+    kinds = [r["kind"] for r in rep.all_rows()]
+    assert kinds.count("latency") == 2 and kinds[0] == "meta"
+
+
+def test_unsound_spans_never_feed_the_sketch():
+    """A span that neither fenced outputs nor declared sync="host" may
+    have timed dispatch only — folding it would hide the host-wall
+    conflation behind an SLO verdict. Such spans keep one row each
+    (visible to trace_report --strict) and never enter the sketch."""
+    rep = obs.RunReport("t", latency=True)
+    for _ in range(3):
+        with rep.span("unfenced"):
+            pass
+    spans = [r for r in rep.rows if r["kind"] == "span"]
+    assert len(spans) == 3 and all(not r["fenced"] for r in spans)
+    assert rep.latency_rows() == []
+
+
+def test_error_spans_are_neither_folded_nor_suppressed():
+    rep = obs.RunReport("t", latency=True)
+    with rep.span("s", sync="host"):
+        pass
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            with rep.span("s", sync="host"):
+                raise RuntimeError("boom")
+    spans = [r for r in rep.rows if r["kind"] == "span"]
+    # 1 clean row + 2 error rows: a crashed window is not a latency
+    # sample and must never hide behind the rollup
+    assert len(spans) == 3
+    assert [bool(r.get("error")) for r in spans] == [False, True, True]
+    assert rep.latency_rows()[0]["count"] == 1
+
+
+def test_error_on_first_occurrence_does_not_suppress_later_clean_rows():
+    """Only a CLEAN folded row marks a scope as seen: a scope whose
+    first occurrence crashed still gets its first clean span row."""
+    rep = obs.RunReport("t", latency=True)
+    with pytest.raises(RuntimeError):
+        with rep.span("s", sync="host"):
+            raise RuntimeError("boom")
+    with rep.span("s", sync="host"):
+        pass
+    with rep.span("s", sync="host"):
+        pass
+    spans = [r for r in rep.rows if r["kind"] == "span"]
+    # error row + first clean row; the second clean exit folds
+    assert [bool(r.get("error")) for r in spans] == [True, False]
+    assert rep.latency_rows()[0]["count"] == 2
+
+
+def test_instrument_jit_records_steady_state_calls_only():
+    """Per-call fenced latency from an instrumented entry point: the
+    compiling call is excluded (compile time is the compile rows' story),
+    every steady-state call lands in the sketch."""
+    step = obs.instrument_jit(jax.jit(lambda x: x * 2.0),
+                              "latency_test/entry")
+    x = jnp.ones((8,))
+    rep = obs.RunReport("t", latency=True)
+    with rep.activate():
+        step(x)          # compiles -> excluded
+        step(x)
+        step(x)
+    lat = {r["name"]: r for r in rep.latency_rows()}
+    row = lat["latency_test/entry"]
+    assert row["count"] == 2
+    assert row["p50_s"] > 0 and row["p99_s"] >= row["p50_s"]
+
+
+# ------------------------------------------------------ structural elision
+
+
+def test_latency_off_never_touches_the_machinery(monkeypatch):
+    """The elision contract, pinned the counting-stub way: with latency
+    off (the default) a full span + instrumented-call + write cycle never
+    calls into obs.latency or obs.devtime at all — the off path is the
+    pre-PR code path, not a disabled feature."""
+    import factormodeling_tpu.obs.devtime as devtime_mod
+    import factormodeling_tpu.obs.latency as latency_mod
+
+    def boom(*a, **k):
+        raise AssertionError("latency/devtime machinery ran while off")
+
+    monkeypatch.setattr(latency_mod.LatencyRecorder, "observe", boom)
+    monkeypatch.setattr(latency_mod.QuantileSketch, "add", boom)
+    monkeypatch.setattr(devtime_mod, "capture", boom)
+
+    step = obs.instrument_jit(jax.jit(lambda x: x + 1.0),
+                              "latency_test/off")
+    x = jnp.ones((4,))
+    rep = obs.RunReport("off")
+    with rep.activate():
+        for _ in range(2):
+            with rep.span("s") as sp:
+                sp.add(step(x))
+    # repeats stay individual rows (no sketch to fold into), no latency
+    # rows appear, and nothing raised above
+    assert len([r for r in rep.rows if r["kind"] == "span"]) == 2
+    assert rep.latency_rows() == []
+    assert all(r["kind"] != "latency" for r in rep.all_rows())
+
+
+def test_slos_imply_a_recorder():
+    rep = obs.RunReport("t", slos=[SLOSpec("a", 0.99, 1.0)])
+    assert rep.latency is not None
+    with rep.span("a", sync="host"):
+        pass
+    row = rep.latency_rows()[0]
+    assert row["slo_budget_s"] == 1.0 and row["slo_violated"] is False
+
+
+def test_shared_recorder_across_reports_merges_scopes():
+    rec = LatencyRecorder()
+    for label in ("a", "b"):
+        rep = obs.RunReport(label, latency=rec)
+        with rep.span("shared/scope", sync="host"):
+            pass
+    assert rec.sketch("shared/scope").count == 2
+
+
+def test_latency_rows_carry_the_scope_max_memory_watermark(monkeypatch):
+    """Suppressed repeat spans must not hide a blown device-memory
+    watermark: the latency row carries the scope's max gauge (driven
+    through a faked live_watermark — CPU reports none)."""
+    from factormodeling_tpu.obs import memory as memory_mod
+
+    peaks = iter([100, 900, 300])
+    monkeypatch.setattr(
+        memory_mod, "live_watermark",
+        lambda: {"bytes_in_use": 1, "peak_bytes_in_use": next(peaks),
+                 "devices": 1})
+    rep = obs.RunReport("t", latency=True)
+    for _ in range(3):
+        with rep.span("chunk", sync="host"):
+            pass
+    spans = [r for r in rep.rows if r["kind"] == "span"]
+    assert len(spans) == 1 and spans[0]["mem_peak_bytes"] == 100
+    row = rep.latency_rows()[0]
+    assert row["count"] == 3
+    assert row["mem_peak_bytes_max"] == 900  # the suppressed repeat's
+
+
+# --------------------------------------------- the bench SLO row (smoke)
+
+
+def test_bench_daily_advance_emits_a_latency_row():
+    """The acceptance contract of ``bench.py daily_advance_p50_p99`` at
+    smoke shape: a ``kind="latency"`` row with nonzero count and finite
+    p50/p99 lands in the active report, the published row carries the
+    quantiles + SLO verdict, and the replay certified kernel-cache
+    steady state (the bench asserts hits == dates internally)."""
+    import bench
+
+    rep = obs.RunReport("t")
+    with rep.activate():
+        row = bench.bench_daily_advance(smoke=True)
+    assert row["count"] > 0
+    assert np.isfinite([row["p50_s"], row["p99_s"]]).all()
+    assert row["slo"]["scope"] == "bench/daily_advance"
+    lat = [r for r in rep.rows if r.get("kind") == "latency"]
+    assert len(lat) == 1 and lat[0]["name"] == "bench/daily_advance"
+    assert lat[0]["count"] == row["count"] > 0
+    assert np.isfinite([lat[0]["p50_s"], lat[0]["p99_s"]]).all()
+    # the bench row itself is gateable by report_diff's bench check
+    assert row["unit"] == "s" and np.isfinite(row["value"])

@@ -226,7 +226,12 @@ def default_targets(repo_root=None) -> list[Path]:
     (round 12): its checkpoint IO deliberately fences (each save is a
     host transfer) and its retry/backoff sleeps sit next to timing calls
     — exactly where a careless wall-clock window would land; the chaos
-    CLI rides the tools/ glob."""
+    CLI rides the tools/ glob. The latency/devtime modules (round 13)
+    ride the obs/ glob: latency.py defines the sketch every SLO number
+    flows through and devtime.py/compile_log.py own perf_counter windows
+    that MUST fence (the recorder's whole claim is fenced per-call
+    latency) — pinned by name in the coverage test so a move out of
+    obs/ can't silently drop them."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))

@@ -14,8 +14,12 @@ example), and gate merges on this diff — a span that got 1.5x slower, a
 solver-fallback counter that ticked up, a probe stage whose finite
 fraction dropped (the watchdog names the first bad stage), a silent jit
 retrace, a new collective / comms-byte blowup in the placement ledger, a
-peak-device-memory jump, or a sharding-lint flag (replicated/resharded
-operand) all exit 1 with a one-line attribution. Reports with mismatched
+peak-device-memory jump, a sharding-lint flag (replicated/resharded
+operand), a latency-sketch p50/p99 beyond the wall ratio, a violated
+``SLOSpec`` budget (gated even under ``--no-wall`` — the budget is the
+run's own declaration, not a machine comparison), or a seconds-valued
+bench row beyond the ratio AND the baseline's recorded best-of-N spread
+all exit 1 with a one-line attribution. Reports with mismatched
 ``kind="meta"`` schema versions REFUSE to gate; cross-backend pairs warn
 and skip wall gating automatically.
 

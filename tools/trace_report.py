@@ -7,22 +7,25 @@ Usage::
 Spans aggregate by name (count / total / mean / max wall seconds, whether
 they fenced); counters, the solver section (scheme + Anderson-acceleration
 telemetry), numerics probes, compile telemetry, the placement ledger
-(comms / device memory / sharding lint), cost-analysis estimates, bench
-rows, and plain stage records print in their own sections. Pure
-stdlib — usable on any box that has the JSONL, no jax required.
+(comms / device memory / sharding lint), latency sketches (per-scope
+count + p50/p90/p99 + SLO verdict), device-time attribution, cost-analysis
+estimates, bench rows, and plain stage records print in their own
+sections. Pure stdlib — usable on any box that has the JSONL, no jax
+required.
 
-Exit codes: 0 = rendered (``--strict`` turns unsound spans / sharding-lint
-flags into 1); 2 = unusable input (missing/unreadable file, or no
-parseable rows at all — empty or fully corrupt). A truncated tail — a run
-killed mid-write — is skipped with a file:line warning and the surviving
-rows still render: partial evidence is exactly what a report of a broken
-run is for.
+Exit codes: 0 = rendered (``--strict`` turns unsound spans, sharding-lint
+flags, SLO violations, and malformed latency/devtime rows into 1);
+2 = unusable input (missing/unreadable file, or no parseable rows at all
+— empty or fully corrupt). A truncated tail — a run killed mid-write — is
+skipped with a file:line warning and the surviving rows still render:
+partial evidence is exactly what a report of a broken run is for.
 """
 
 from __future__ import annotations
 
 import argparse
 import importlib.util
+import math
 import sys
 from collections import defaultdict
 from pathlib import Path
@@ -316,12 +319,66 @@ def _sharding_table(rows) -> str | None:
                          body))
 
 
+def _latency_table(rows) -> str | None:
+    lat = [r for r in rows if r.get("kind") == "latency"]
+    if not lat:
+        return None
+    # last row per scope wins (rows carry cumulative sketches)
+    last: dict[str, dict] = {}
+    for r in lat:
+        last[r.get("name", "?")] = r
+
+    def s(r, key):
+        v = r.get(key)
+        return f"{float(v):.6g}" if isinstance(v, (int, float)) else "-"
+
+    body = []
+    for name, r in sorted(last.items()):
+        if r.get("slo_budget_s") is not None:
+            slo = (f"{r.get('slo_quantile')}q<={r.get('slo_budget_s')}s "
+                   + ("VIOLATED" if r.get("slo_violated") else "ok"))
+        else:
+            slo = "-"
+        body.append((name, r.get("count", "-"), s(r, "total_s"),
+                     s(r, "p50_s"), s(r, "p90_s"), s(r, "p99_s"),
+                     s(r, "max_s"), slo))
+    return ("== latency sketches (per-scope streaming quantiles; repeated "
+            "spans roll up here) ==\n"
+            + _fmt_table(("scope", "n", "total_s", "p50_s", "p90_s",
+                          "p99_s", "max_s", "slo"), body))
+
+
+def _devtime_table(rows) -> str | None:
+    dt = [r for r in rows if r.get("kind") == "devtime"]
+    if not dt:
+        return None
+    body = []
+    for r in dt:
+        if "error" in r:
+            note = f"error: {r['error'][:60]}"
+        elif "skipped" in r:
+            note = f"skipped: {r['skipped'][:60]}"
+        else:
+            note = ""
+        def g(key, fmt="{:.6g}"):
+            v = r.get(key)
+            return fmt.format(float(v)) if isinstance(v, (int, float)) \
+                else "-"
+        body.append((r.get("name", "?"), r.get("stage", "?"),
+                     g("device_s"), g("wall_s"),
+                     g("host_overhead_frac", "{:.4f}"), note))
+    return ("== device time (profiler attribution per obs.stage scope; "
+            "skipped = backend exports no device tracks) ==\n"
+            + _fmt_table(("entry_point", "stage", "device_s", "wall_s",
+                          "host_frac", "note"), body))
+
+
 def _stage_table(rows) -> str | None:
     stages = [r for r in rows
               if r.get("kind") not in ("span", "counters", "cost", "bench",
                                        "numerics", "watchdog", "compile",
                                        "comms", "memory", "sharding",
-                                       "meta")]
+                                       "latency", "devtime", "meta")]
     if not stages:
         return None
     body = []
@@ -365,11 +422,11 @@ def render(rows) -> str:
             ("schema_version", "jax_version", "backend", "device_kind",
              "device_count", "mesh_shape") if meta.get(k) is not None))
     sections = [head]
-    for maker in (_span_table, _counter_table, _solver_table,
-                  _numerics_table,
+    for maker in (_span_table, _latency_table, _counter_table,
+                  _solver_table, _numerics_table,
                   _watchdog_table, _compile_table, _comms_table,
-                  _memory_table, _sharding_table, _cost_table,
-                  _bench_table, _stage_table):
+                  _memory_table, _sharding_table, _devtime_table,
+                  _cost_table, _bench_table, _stage_table):
         section = maker(rows)
         if section:
             sections.append(section)
@@ -398,6 +455,44 @@ def lint_flagged(rows) -> list[str]:
                    and not r.get("clean", True)})
 
 
+def slo_violations(rows) -> list[str]:
+    """Latency scopes whose SLO verdict is violated — the third
+    ``--strict`` gate (a run that missed its own declared latency budget
+    should fail CI from the artifact alone)."""
+    return sorted({r.get("name", "?") for r in rows
+                   if r.get("kind") == "latency"
+                   and r.get("slo_violated")})
+
+
+def malformed_rows(rows) -> list[str]:
+    """Descriptions of latency/devtime rows missing their contract
+    fields — strict validation of the PR 9 row kinds. A latency row must
+    carry a count and (when non-empty) finite p50/p99; a devtime row must
+    carry device seconds OR an honest skip/error reason."""
+    bad = []
+    for r in rows:
+        kind = r.get("kind")
+        if kind == "latency":
+            n = r.get("count")
+            if not isinstance(n, int) or n < 0:
+                bad.append(f"latency row {r.get('name', '?')!r}: missing/"
+                           f"invalid count {n!r}")
+                continue
+            if n > 0 and not all(
+                    isinstance(r.get(k), (int, float))
+                    and math.isfinite(float(r[k]))
+                    for k in ("p50_s", "p99_s")):
+                bad.append(f"latency row {r.get('name', '?')!r}: count "
+                           f"{n} but p50_s/p99_s missing or non-finite")
+        elif kind == "devtime":
+            if not (isinstance(r.get("device_s"), (int, float))
+                    or "skipped" in r or "error" in r):
+                bad.append(f"devtime row {r.get('name', '?')!r}/"
+                           f"{r.get('stage', '?')}: neither device_s nor "
+                           f"a skip/error reason")
+    return bad
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("jsonl", nargs="+",
@@ -405,9 +500,11 @@ def main(argv=None) -> int:
     parser.add_argument("--strict", action="store_true",
                         help="exit nonzero when any span row is unsound "
                              "(fenced NO: neither a device fence nor a "
-                             "declared host-synchronous window) or any "
-                             "sharding-lint row is flagged — makes the "
-                             "renderer CI-able")
+                             "declared host-synchronous window), any "
+                             "sharding-lint row is flagged, any latency "
+                             "SLO is violated, or any latency/devtime "
+                             "row is malformed — makes the renderer "
+                             "CI-able")
     args = parser.parse_args(argv)
     try:
         rows = load_rows(args.jsonl)
@@ -433,6 +530,16 @@ def main(argv=None) -> int:
         if flagged:
             print(f"strict: {len(flagged)} entry point(s) with sharding-"
                   f"lint flags: " + ", ".join(flagged), file=sys.stderr)
+            rc = 1
+        violated = slo_violations(rows)
+        if violated:
+            print(f"strict: {len(violated)} latency scope(s) violated "
+                  f"their SLO: " + ", ".join(violated), file=sys.stderr)
+            rc = 1
+        malformed = malformed_rows(rows)
+        if malformed:
+            print(f"strict: {len(malformed)} malformed latency/devtime "
+                  f"row(s): " + "; ".join(malformed), file=sys.stderr)
             rc = 1
         return rc
     return 0
