@@ -56,6 +56,15 @@ class SimulationSettings:
     # per-name P&L columns, so there is nothing to switch on-device
     contributor: bool = dataclasses.field(default=False, metadata=dict(static=True))
 
+    # per-caller one-way transaction-cost rate scale applied on top of the
+    # cap-tier table (TCOST_RATES) in :meth:`cost_rates` — the serving
+    # layer's per-tenant t-cost knob (factormodeling_tpu.serve). A traced
+    # leaf, so one compiled step serves a whole batch of scales. None —
+    # the default — traces NO scaling op (the resil-layer elision idiom):
+    # existing goldens and HLO pins are untouched; 1.0 reproduces the
+    # unscaled rates numerically.
+    tcost_scale: "jnp.ndarray | float | None" = None
+
     # MVO knobs
     lookback_period: int = dataclasses.field(default=60, metadata=dict(static=True))
     shrinkage_intensity: float = 0.1
@@ -215,13 +224,28 @@ class SimulationSettings:
         if self.qp_anderson < 0:
             raise ValueError(
                 f"qp_anderson must be >= 0 (0 disables), got {self.qp_anderson}")
+        # concrete host scalars only (incl. numpy scalars — np.float32 is
+        # NOT a python float subclass): a traced tcost_scale (the serving
+        # layer's batched tenants) is validated BEFORE trace time by
+        # serve.frontend / TenantConfig.validate, the qp_anderson precedent
+        if isinstance(self.tcost_scale,
+                      (int, float, np.floating, np.integer)) \
+                and self.tcost_scale < 0:
+            raise ValueError(
+                f"tcost_scale must be >= 0 (None disables), got "
+                f"{self.tcost_scale}")
 
     @property
     def shape(self):
         return self.returns.shape
 
     def cost_rates(self) -> jnp.ndarray:
-        """Per-cell one-way cost rates from the cap tier (missing tier -> 0)."""
+        """Per-cell one-way cost rates from the cap tier (missing tier -> 0),
+        rescaled by ``tcost_scale`` when one is set (None traces no op)."""
         table = jnp.asarray(np.asarray(TCOST_RATES), dtype=self.returns.dtype)
         flags = jnp.nan_to_num(self.cap_flag).astype(jnp.int32)
-        return table[jnp.clip(flags, 0, len(TCOST_RATES) - 1)]
+        rates = table[jnp.clip(flags, 0, len(TCOST_RATES) - 1)]
+        if self.tcost_scale is not None:
+            rates = rates * jnp.asarray(self.tcost_scale,
+                                        dtype=self.returns.dtype)
+        return rates

@@ -215,13 +215,28 @@ def composite_static(factors: jnp.ndarray, names, method: str = "zscore",
 
 def composite_weighted(factors: jnp.ndarray, names, selection: jnp.ndarray,
                        method: str = "zscore",
-                       universe: jnp.ndarray | None = None) -> jnp.ndarray:
+                       universe: jnp.ndarray | None = None,
+                       group_tilt: jnp.ndarray | None = None) -> jnp.ndarray:
     """Per-date weighted blend driven by daily selection weights
     (reference ``weighted_composite_factor``, ``composite_factor.py:220-342``).
 
     ``selection [D, F]`` aligns with ``names``; rows that are all zero (dates
     outside the selection) produce 0. Output is zero-filled like the
     reference's final ``reindex().fillna(0)`` — ``float[D, N]``.
+
+    ``group_tilt`` (``float[G]``, nonnegative, order of
+    :func:`prefix_group_ids`) rescales the day's raw per-group blend
+    weights BEFORE their renormalization — a per-caller preference over
+    the prefix families (the serving layer's per-tenant blend-weight knob;
+    every entry 1 is exactly the untilted blend). A tilt that zeroes
+    every ACTIVE group on a day zeroes that day's composite outright: the
+    reference's equal-weight fallback is suppressed under a tilt, because
+    restoring weight to a group the caller explicitly excluded would
+    silently invert the preference on exactly the days it binds
+    (docs/architecture.md section 20; without a tilt the fallback branch
+    is unreachable — any active factor makes the weight total positive —
+    so untilted behavior is bit-identical to before). None traces
+    nothing new.
     """
     if method not in ("zscore", "rank"):
         raise ValueError("method must be 'zscore' or 'rank'")
@@ -240,11 +255,16 @@ def composite_weighted(factors: jnp.ndarray, names, selection: jnp.ndarray,
 
     onehot = jnp.asarray(np.arange(g)[:, None] == gids, factors.dtype)  # [G, F]
     gw = jnp.einsum("gf,df->dg", onehot, jnp.where(active, selection, 0.0))  # [D, G]
+    if group_tilt is not None:
+        gw = gw * group_tilt[None, :]
     g_active = jnp.einsum("gf,df->dg", onehot, member) > 0  # [D, G]
     total = gw.sum(-1, keepdims=True)
     n_active = g_active.sum(-1, keepdims=True).astype(factors.dtype)
     equal = jnp.where(g_active, 1.0 / jnp.where(n_active > 0, n_active, jnp.nan), 0.0)
-    gw = jnp.where(total > 0, gw / jnp.where(total > 0, total, 1.0), equal)  # [D, G]
+    # tilted callers get NO equal-weight fallback: a tilt-zeroed day must
+    # stay zeroed, not bounce back to the group the tilt excluded
+    fallback = equal if group_tilt is None else jnp.zeros_like(equal)
+    gw = jnp.where(total > 0, gw / jnp.where(total > 0, total, 1.0), fallback)  # [D, G]
 
     if method == "zscore":
         normed = _safe_zscore_rows(proxies, universe)

@@ -61,7 +61,12 @@ flags):
   present in both reports gates its value at ``wall_ratio`` — against
   ``max(baseline value, baseline spread max)`` when the baseline carries
   a ``spread`` (best-of-N min/max), so a documented container-speed
-  swing absorbs into the gate instead of crying wolf.
+  swing absorbs into the gate instead of crying wolf. RATE-valued rows
+  (unit ending in ``/s`` — the serving layer's
+  ``tenant_sweep_configs_per_sec`` throughput) gate in the OPPOSITE
+  direction under the same conventions: a drop below ``baseline /
+  wall_ratio`` is a regression, judged against ``min(baseline value,
+  baseline spread min)`` so the recorded run-to-run swing absorbs first.
 
 Deliberately **pure stdlib** with no package-relative imports:
 ``tools/report_diff.py`` loads this file standalone (importlib by path) so
@@ -583,28 +588,52 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
         base_b, new_b = bench_rows(base_rows), bench_rows(new_rows)
         for name in sorted(set(base_b) & set(new_b)):
             base_row, new_row = base_b[name], new_b[name]
-            if base_row.get("unit", "s") != "s" \
-                    or new_row.get("unit", "s") != "s":
+            unit = base_row.get("unit", "s")
+            if unit != new_row.get("unit", "s"):
                 continue
             b, n = base_row.get("value"), new_row.get("value")
             if not isinstance(b, (int, float)) \
-                    or not isinstance(n, (int, float)) or b < wall_min_s:
+                    or not isinstance(n, (int, float)):
                 continue
             spread = base_row.get("spread") or {}
-            smax = spread.get("max_s")
-            eff = max(b, smax) if isinstance(smax, (int, float)) else b
-            if n > wall_ratio * eff:
-                findings.append(Finding(
-                    "bench", name,
-                    f"value {b:.6g}s -> {n:.6g}s ({n / b:.2f}x; exceeds "
-                    f"{wall_ratio:g}x even against the baseline spread "
-                    f"max {eff:.6g}s)", regression=True))
-            elif n > wall_ratio * b:
-                findings.append(Finding(
-                    "bench", name,
-                    f"value {b:.6g}s -> {n:.6g}s ({n / b:.2f}x) — within "
-                    f"the baseline's recorded best-of-N spread (max "
-                    f"{eff:.6g}s), so judged run-to-run swing, not a "
-                    f"regression"))
+            if unit == "s":
+                if b < wall_min_s:
+                    continue
+                smax = spread.get("max_s")
+                eff = max(b, smax) if isinstance(smax, (int, float)) else b
+                if n > wall_ratio * eff:
+                    findings.append(Finding(
+                        "bench", name,
+                        f"value {b:.6g}s -> {n:.6g}s ({n / b:.2f}x; exceeds "
+                        f"{wall_ratio:g}x even against the baseline spread "
+                        f"max {eff:.6g}s)", regression=True))
+                elif n > wall_ratio * b:
+                    findings.append(Finding(
+                        "bench", name,
+                        f"value {b:.6g}s -> {n:.6g}s ({n / b:.2f}x) — within "
+                        f"the baseline's recorded best-of-N spread (max "
+                        f"{eff:.6g}s), so judged run-to-run swing, not a "
+                        f"regression"))
+            elif unit.endswith("/s"):
+                # throughput rows (bigger is better): a drop below
+                # baseline / wall_ratio gates, spread-min absorbing first
+                if b <= 0:
+                    continue
+                smin = spread.get("min_s")
+                eff = min(b, smin) if isinstance(smin, (int, float)) else b
+                if n * wall_ratio < eff:
+                    findings.append(Finding(
+                        "bench", name,
+                        f"throughput {b:.6g} -> {n:.6g} {unit} "
+                        f"({b / max(n, 1e-300):.2f}x drop; below 1/"
+                        f"{wall_ratio:g} even against the baseline spread "
+                        f"min {eff:.6g})", regression=True))
+                elif n * wall_ratio < b:
+                    findings.append(Finding(
+                        "bench", name,
+                        f"throughput {b:.6g} -> {n:.6g} {unit} — within "
+                        f"the baseline's recorded best-of-N spread (min "
+                        f"{eff:.6g}), so judged run-to-run swing, not a "
+                        f"regression"))
 
     return DiffResult(findings=findings, first_bad_stage=first_bad)

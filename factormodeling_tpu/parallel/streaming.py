@@ -126,12 +126,20 @@ def _evict_to_cap() -> None:
         _cache_stats["evictions"] += 1
 
 
-def _cached_kernel(source, config, build):
+def _cached_kernel(source, config, build, *, name=None,
+                   expected_signatures=None):
     """jit for (source, config), LRU-bounded; ``source`` (None for the host
     path) participates in the key by identity. Kernels carry compile
     telemetry (``obs.compile_log``): per-kernel compile seconds land as
     RunReport rows and the retrace detector catches a cache-defeating
-    unstable source before it becomes a minutes-long slowdown."""
+    unstable source before it becomes a minutes-long slowdown.
+
+    ``name``/``expected_signatures`` override the telemetry entry-point
+    name and the retrace detector's pinned signature count — the serving
+    layer's per-bucket executables ride this SAME bounded LRU (one cache
+    entry per signature bucket, evictions counted honestly against the
+    streaming kernels' working set) but report under ``serve/...`` names
+    (factormodeling_tpu.serve.frontend)."""
     key = (source, config)
     fn = _kernel_cache.pop(key, None)
     if fn is None:
@@ -141,8 +149,10 @@ def _cached_kernel(source, config, build):
         # retrace; the tag is callable-qualname-based, so the storm this
         # cache guards against (fresh lambda sources, one config) still
         # accumulates under a single name and flags
-        fn = instrument_jit(build(), f"streaming/{config[0]}/kernel/"
-                                     f"{entry_point_tag(config)}")
+        fn = instrument_jit(build(),
+                            name or f"streaming/{config[0]}/kernel/"
+                                    f"{entry_point_tag(config)}",
+                            expected_signatures=expected_signatures)
         _cache_stats["misses"] += 1
     else:
         _cache_stats["hits"] += 1

@@ -5,7 +5,9 @@ Reference surface: ``factor_selector.py`` + ``factor_selection_methods.py``.
 
 from factormodeling_tpu.selection.driver import (  # noqa: F401
     build_selection_context,
+    finalize_selection,
     rolling_selection,
+    selection_metric_needs,
 )
 from factormodeling_tpu.selection.selectors import (  # noqa: F401
     FACTOR_SELECTION_METHODS,

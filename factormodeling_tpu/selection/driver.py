@@ -33,7 +33,8 @@ from factormodeling_tpu.selection.selectors import (
     regression_selector,
 )
 
-__all__ = ["rolling_selection", "build_selection_context"]
+__all__ = ["rolling_selection", "build_selection_context",
+           "finalize_selection", "selection_metric_needs"]
 
 #: daily stats each built-in selector actually reads, as a function of its
 #: method_kwargs (see the selector bodies in selectors.py): icir_top reads
@@ -112,6 +113,40 @@ def _finish_context(metrics_win: dict, factor_ret: jnp.ndarray,
     )
 
 
+def selection_metric_needs(method: str, method_kwargs: dict | None = None):
+    """The daily stats the chosen selector actually reads (see
+    ``_METRIC_NEEDS``): built-in selectors skip stats they never consume —
+    icir_top drops the rank sort when scoring on plain IC_IR — while custom
+    registry entries get the full table (their consumption is unknown).
+    Raises on an unregistered method, like :func:`rolling_selection`.
+
+    Exposed for callers that build the :class:`SelectionContext` once and
+    drive the selector separately — the serving layer's batched step hoists
+    the context out of its config vmap this way
+    (:func:`factormodeling_tpu.serve.make_batched_research_step`)."""
+    selector = FACTOR_SELECTION_METHODS.get(method)
+    if selector is None:
+        raise ValueError(f"Unknown factor selection method: {method}")
+    needs_fn = _METRIC_NEEDS.get(selector)
+    return needs_fn(method_kwargs or {}) if needs_fn else _ALL_STATS
+
+
+def finalize_selection(raw: jnp.ndarray, window: int) -> jnp.ndarray:
+    """The driver's output contract on a selector's raw ``[D, F]`` rows:
+    zero outside the processed range ``dates[window:-1]``
+    (``factor_selector.py:131-136``), NaN -> 0, rows normalized to sum 1
+    with all-zero rows left at 0. Split out of :func:`rolling_selection`
+    so a caller with its own raw weights (e.g. a per-tenant manager-mix
+    tilt over the rank-mask selection) lands on the identical contract."""
+    d = raw.shape[0]
+    i = jnp.arange(d)
+    processed = (i >= window) & (i <= d - 2)
+    raw = jnp.where(processed[:, None], raw, 0.0)
+    raw = jnp.where(jnp.isnan(raw), 0.0, raw)
+    rowsum = raw.sum(axis=1, keepdims=True)
+    return jnp.where(rowsum > 0, raw / jnp.where(rowsum > 0, rowsum, 1.0), 0.0)
+
+
 def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
                       factor_ret: jnp.ndarray, window: int,
                       method: str = "icir_top", method_kwargs: dict | None = None,
@@ -130,8 +165,7 @@ def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
     # built-in selectors only compute the metric stats they actually read
     # (skipping the rank sort where possible); custom registry entries get
     # the full table — their consumption is unknown
-    needs_fn = _METRIC_NEEDS.get(selector)
-    needs = needs_fn(method_kwargs or {}) if needs_fn else _ALL_STATS
+    needs = selection_metric_needs(method, method_kwargs)
     with obs_stage("selection/context"):
         ctx = build_selection_context(factors, returns, factor_ret, window,
                                       universe=universe,
@@ -139,11 +173,4 @@ def rolling_selection(factors: jnp.ndarray, returns: jnp.ndarray,
                                       stats=needs)
     with obs_stage(f"selection/selector/{method}"):
         raw = selector(ctx, **(method_kwargs or {}))  # [D, F]
-
-    d = factor_ret.shape[0]
-    i = jnp.arange(d)
-    processed = (i >= window) & (i <= d - 2)
-    raw = jnp.where(processed[:, None], raw, 0.0)
-    raw = jnp.where(jnp.isnan(raw), 0.0, raw)
-    rowsum = raw.sum(axis=1, keepdims=True)
-    return jnp.where(rowsum > 0, raw / jnp.where(rowsum > 0, rowsum, 1.0), 0.0)
+    return finalize_selection(raw, window)

@@ -1,0 +1,34 @@
+"""Many-tenant batched serving (ROADMAP item 1, architecture.md §20).
+
+One compiled executable serves a whole *signature bucket* of tenant
+configurations per dispatch:
+
+- :mod:`~factormodeling_tpu.serve.tenant` — :class:`TenantConfig`, the
+  traced config pytree: per-tenant knobs (rank-mask top-k, manager mix,
+  blend tilt, simulation floats, t-cost scale) are leaves; the
+  program-shaping residue (method, window, selector/blend choice, qp
+  knobs) is static and partitions configs into buckets via
+  :meth:`TenantConfig.static_key`.
+- :mod:`~factormodeling_tpu.serve.batched` —
+  :func:`make_batched_research_step`, the config-vmap step (market panels
+  broadcast, selection metric stack hoisted out of the vmap) and its
+  single-config counterpart :func:`make_tenant_research_step`.
+- :mod:`~factormodeling_tpu.serve.frontend` — :class:`TenantServer`: the
+  request-batching front end (validate -> bucket -> pad-ladder -> AOT
+  dispatch through the streaming kernel LRU -> demux) with
+  ``serve/bucket/...`` compile/latency telemetry.
+"""
+
+from factormodeling_tpu.serve.batched import (  # noqa: F401
+    make_batched_research_step,
+    make_tenant_research_step,
+)
+from factormodeling_tpu.serve.frontend import (  # noqa: F401
+    DEFAULT_PAD_LADDER,
+    TenantResult,
+    TenantServer,
+)
+from factormodeling_tpu.serve.tenant import (  # noqa: F401
+    TenantConfig,
+    stack_configs,
+)

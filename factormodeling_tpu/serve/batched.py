@@ -1,0 +1,158 @@
+"""The config-vmap research step: one traced program, a batch of tenants.
+
+``make_batched_research_step`` vmaps the per-tenant research pipeline over
+a config axis ``C`` while the market panels stay broadcast:
+
+- the **config-independent prefix is hoisted out of the vmap**: the
+  selection metric stack (rank-IC / ICIR rolling metrics — the [F, D, N]
+  rank sort that dominates a single-config step) depends only on data, so
+  it is built ONCE per dispatch via
+  :func:`~factormodeling_tpu.selection.build_selection_context` and closed
+  over by the vmapped tenant body. Because the context never touches a
+  tenant leaf, vmap leaves it unbatched — no ``[C, F, D, N]`` operand ever
+  exists (pinned structurally on the optimized HLO in
+  ``tests/test_serve.py``).
+- everything downstream of a tenant leaf batches: the traced rank-mask
+  top-k over the ICIR scores, the manager-mix split, the group-tilted
+  weighted blend (pooled percentiles depend on the day's ACTIVE columns,
+  which are config-dependent — correctly per-tenant), the simulation under
+  the tenant's traced ``SimulationSettings`` leaves, and the summary.
+
+The per-tenant body is also exposed single-config
+(``make_tenant_research_step``) — the sequential baseline the serving
+bench loops through ONE compiled executable, and the differential anchor
+the parity tests pin lanes against.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from factormodeling_tpu.backtest.engine import run_simulation
+from factormodeling_tpu.backtest.settings import SimulationSettings
+from factormodeling_tpu.composite import composite_weighted
+from factormodeling_tpu.obs.trace import stage as obs_stage
+from factormodeling_tpu.parallel.pipeline import ResearchOutput, result_summary
+from factormodeling_tpu.selection import (
+    FACTOR_SELECTION_METHODS,
+    build_selection_context,
+    finalize_selection,
+    selection_metric_needs,
+)
+from factormodeling_tpu.serve.tenant import TenantConfig
+
+__all__ = ["make_tenant_research_step", "make_batched_research_step"]
+
+
+def _make_parts(names, template: TenantConfig):
+    """(build_ctx, tenant_body) closed over the bucket's static residue."""
+    names = tuple(names)
+    window = template.window
+    select_method = template.select_method
+    select_static = dict(template.select_static)
+    if select_method == "icir_top":
+        # the traced leaves own these; a static copy in select_static
+        # would silently pin every tenant to one value
+        for k in ("top_x", "icir_threshold", "use_rank_icir"):
+            if k in select_static:
+                raise ValueError(
+                    f"select_static[{k!r}] shadows the traced icir_top "
+                    f"knobs (top_k / icir_threshold) or the static "
+                    f"use_rank_icir field")
+        select_static["use_rank_icir"] = template.use_rank_icir
+    selector = FACTOR_SELECTION_METHODS.get(select_method)
+    if selector is None:
+        raise ValueError(f"Unknown factor selection method: {select_method}")
+    needs = selection_metric_needs(select_method, select_static)
+    sim_static = dict(template.sim_static)
+
+    def build_ctx(factors, returns, factor_ret, universe):
+        if window >= factor_ret.shape[0]:
+            raise ValueError(
+                f"window {window} >= {factor_ret.shape[0]} dates: the "
+                f"processed range is empty, nothing to serve")
+        with obs_stage("serve/context"):
+            return build_selection_context(factors, returns, factor_ret,
+                                           window, universe=universe,
+                                           stats=needs)
+
+    def tenant_body(t: TenantConfig, ctx, factors, returns, cap_flag,
+                    investability, universe) -> ResearchOutput:
+        kwargs = dict(select_static)
+        if select_method == "icir_top":
+            kwargs.update(top_x=t.top_k, icir_threshold=t.icir_threshold)
+        with obs_stage("serve/selection"):
+            raw = selector(ctx, **kwargs)  # [D, F]
+            if t.manager_mix is not None:
+                # capital splits among the day's selected factors by the
+                # tenant's manager mix (multimanager.py combination at the
+                # factor-weight level); the driver renormalizes rows
+                raw = raw * t.manager_mix[None, :]
+            sel = finalize_selection(raw, window)
+        with obs_stage("serve/blend"):
+            signal = composite_weighted(factors, names, sel,
+                                        method=template.blend_method,
+                                        universe=universe,
+                                        group_tilt=t.blend_tilt)
+        settings = SimulationSettings(
+            returns=returns, cap_flag=cap_flag,
+            investability_flag=investability, universe=universe,
+            method=template.method, lookback_period=template.lookback_period,
+            max_weight=t.max_weight, pct=t.pct,
+            shrinkage_intensity=t.shrinkage_intensity,
+            turnover_penalty=t.turnover_penalty,
+            return_weight=t.return_weight, tcost_scale=t.tcost_scale,
+            **sim_static)
+        sim = run_simulation(signal, settings)
+        with obs_stage("pipeline/summary"):
+            summary = result_summary(sim.result)
+        return ResearchOutput(selection=sel, signal=signal, sim=sim,
+                              summary=summary)
+
+    return build_ctx, tenant_body
+
+
+def make_tenant_research_step(*, names, template: TenantConfig):
+    """Single-config counterpart of the batched step: a jittable
+    ``step(tenant, factors, returns, factor_ret, cap_flag, investability,
+    universe)`` whose tenant knobs are TRACED — one compiled executable
+    serves every config in the template's signature bucket, one config
+    per dispatch. This is the sequential serving baseline the bench's
+    batched-vs-sequential ratio loops through the SAME executable."""
+    build_ctx, tenant_body = _make_parts(names, template)
+
+    def step(tenant, factors, returns, factor_ret, cap_flag, investability,
+             universe=None) -> ResearchOutput:
+        ctx = build_ctx(factors, returns, factor_ret, universe)
+        return tenant_body(tenant, ctx, factors, returns, cap_flag,
+                           investability, universe)
+
+    return step
+
+
+def make_batched_research_step(*, names, template: TenantConfig):
+    """The config-vmap step: a jittable ``step(tenants, factors, returns,
+    factor_ret, cap_flag, investability, universe)`` where ``tenants`` is
+    a :func:`~factormodeling_tpu.serve.stack_configs` batch (every leaf
+    carries a leading ``C`` axis) and every other argument is broadcast.
+    Returns a :class:`~factormodeling_tpu.parallel.ResearchOutput` whose
+    leaves carry the config axis: ``selection [C, D, F]``, ``signal
+    [C, D, N]``, stacked simulation outputs and summaries.
+
+    The selection metric context is built OUTSIDE the vmap (module docs);
+    per-tenant lanes see it as an unbatched closure, so the [F, D, N]
+    metric stack is computed once per dispatch, not once per tenant."""
+    build_ctx, tenant_body = _make_parts(names, template)
+
+    def step(tenants, factors, returns, factor_ret, cap_flag, investability,
+             universe=None) -> ResearchOutput:
+        ctx = build_ctx(factors, returns, factor_ret, universe)
+
+        def one(t):
+            return tenant_body(t, ctx, factors, returns, cap_flag,
+                               investability, universe)
+
+        with obs_stage("serve/tenants"):
+            return jax.vmap(one)(tenants)
+
+    return step

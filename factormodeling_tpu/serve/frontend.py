@@ -1,0 +1,231 @@
+"""Request-batching serving front end: signature buckets, the pad ladder,
+AOT executables, and per-tenant demux.
+
+``TenantServer`` owns the market panels and answers ``serve(configs)``:
+
+1. **validate** — every submitted :class:`TenantConfig` is checked
+   host-side (:meth:`TenantConfig.validate`) BEFORE anything traces: an
+   invalid config raises a ValueError and never reaches compile (pinned
+   in tests/test_serve.py).
+2. **bucket** — configs partition by :meth:`TenantConfig.static_key`;
+   each bucket shares one traced program.
+3. **pad** — each bucket dispatches in chunks padded up a fixed size
+   ladder (default ``1/8/64/512``): steady-state serving only ever sees
+   ladder-sized config batches, so the executable set is finite and
+   nothing retraces as traffic fluctuates. Pad lanes replicate the
+   chunk's last config and are discarded at demux (a vmapped lane cannot
+   affect its neighbors). Each chunk pads UP to a single rung — the
+   property that keeps compiles == bucket count — so a count just above
+   a rung gap pays for the next rung's lanes (65 configs -> rung 512 on
+   the default ladder); size the ladder to your traffic
+   (docs/architecture.md section 20's honest-limits note).
+4. **dispatch** — one executable per (bucket, rung), AOT-compiled on
+   first use (``jit(...).lower().compile()`` — the compiled artifact is
+   invoked directly, the ``examples/pipeline.py`` placement-leg idiom)
+   and cached in the streaming layer's bounded kernel LRU
+   (``parallel/streaming.py::_cached_kernel``): serving executables and
+   streaming kernels share ONE honestly-bounded working set, and a
+   1000-tenant sweep occupies one cache entry per bucket (pinned).
+   Dispatch rides :func:`~factormodeling_tpu.obs.compile_log.
+   instrument_jit` under a ``serve/bucket/...`` entry-point name with
+   ``expected_signatures=1``: every compile lands as a ``kind="compile"``
+   report row, a second compile of one executable flags the retrace
+   detector, and with ``RunReport(latency=True)`` active every dispatch's
+   fenced wall lands in the per-bucket quantile sketch (the PR 8 SLO
+   machinery).
+5. **demux** — per-tenant :class:`~factormodeling_tpu.parallel.
+   ResearchOutput` slices, in submission order.
+
+Donation: the stacked config pytree (argument 0) is donated on backends
+that support buffer donation — each dispatch stacks fresh host arrays, so
+the donated buffers are never reused. The market panels are NOT donated:
+one server serves many buckets and many dispatches from the same panel
+buffers, and donating them would invalidate the inputs after the first
+dispatch (docs/architecture.md section 20's honest-limits note).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from factormodeling_tpu.composite import prefix_group_ids
+from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.compile_log import entry_point_tag
+from factormodeling_tpu.parallel import streaming as _streaming
+from factormodeling_tpu.parallel.pipeline import ResearchOutput
+from factormodeling_tpu.serve.batched import make_batched_research_step
+from factormodeling_tpu.serve.tenant import TenantConfig, stack_configs
+
+__all__ = ["DEFAULT_PAD_LADDER", "TenantResult", "TenantServer"]
+
+#: steady-state batch sizes: a bucket of C configs dispatches in chunks
+#: padded up to the smallest rung >= C (chunks of the top rung when C
+#: exceeds it), so the executable set per bucket is at most len(ladder)
+DEFAULT_PAD_LADDER = (1, 8, 64, 512)
+
+
+class TenantResult(NamedTuple):
+    index: int              # position in the submitted config list
+    config: TenantConfig    # the config as submitted (pre-normalization)
+    output: ResearchOutput  # this tenant's lane (selection/signal/sim/summary)
+
+
+def _rung_for(count: int, ladder) -> int:
+    for r in ladder:
+        if count <= r:
+            return r
+    return ladder[-1]
+
+
+class TenantServer:
+    """Many-tenant serving over one fixed market panel set (module docs).
+
+    Args:
+      names: factor names (the composite's prefix/suffix convention).
+      factors: ``float[F, D, N]`` raw exposures; returns: ``float[D, N]``;
+      factor_ret: ``float[D, F]``; cap_flag / investability: ``[D, N]``;
+      universe: optional ``bool[D, N]``.
+      pad_ladder: ascending batch-size rungs (default ``1/8/64/512``).
+      donate_configs: donate the stacked config buffers to the executable
+        (None -> auto: on for non-CPU backends; CPU jaxlib ignores
+        donation with a warning, so auto keeps test output clean).
+    """
+
+    def __init__(self, *, names, factors, returns, factor_ret, cap_flag,
+                 investability, universe=None,
+                 pad_ladder=DEFAULT_PAD_LADDER, donate_configs=None):
+        self.names = tuple(names)
+        ladder = tuple(sorted(set(int(r) for r in pad_ladder)))
+        if not ladder or ladder[0] < 1:
+            raise ValueError(f"pad_ladder must hold positive sizes, "
+                             f"got {pad_ladder!r}")
+        self.pad_ladder = ladder
+        self._panels = tuple(
+            None if a is None else jnp.asarray(a)
+            for a in (factors, returns, factor_ret, cap_flag, investability,
+                      universe))
+        f, d, n = self._panels[0].shape
+        if len(self.names) != f:
+            raise ValueError(f"{len(self.names)} names for a factor stack "
+                             f"of {f}")
+        self.n_dates = d
+        _, prefixes = prefix_group_ids(self.names)
+        self.n_groups = len(prefixes)
+        self._dtype = np.dtype(self._panels[1].dtype)
+        if donate_configs is None:
+            donate_configs = jax.default_backend() != "cpu"
+        self._donate = bool(donate_configs)
+        # serving tallies (streaming_cache_stats-style; see serving_stats)
+        self._buckets_seen: set = set()
+        self._executables_seen: set = set()
+        self._stats = {"dispatches": 0, "configs_served": 0,
+                       "padded_lanes": 0, "rejected_configs": 0}
+
+    # ------------------------------------------------------- executables
+
+    def _executable(self, skey, rung: int, template: TenantConfig):
+        """One AOT executable per (bucket, rung), via the streaming kernel
+        LRU — the cache key is value-based (static residue + rung + panel
+        shapes/dtypes), so equal-market servers share executables and the
+        cache stays one entry per bucket under any tenant count."""
+        shapes = tuple(None if a is None else
+                       (tuple(a.shape), str(a.dtype)) for a in self._panels)
+        config = ("serve", self.names, skey, rung, shapes)
+        name = f"serve/bucket/{entry_point_tag(config)}"
+
+        def build():
+            step = make_batched_research_step(names=self.names,
+                                              template=template)
+            donate = (0,) if self._donate else ()
+            jitted = jax.jit(step, donate_argnums=donate)
+            state = {}
+
+            def dispatch(tenants, *panels):
+                exe = state.get("exe")
+                if exe is None:
+                    # AOT: compile once, invoke the compiled artifact
+                    # directly ever after (the placement-leg idiom) — the
+                    # compile lands inside the instrumented call window,
+                    # so it is attributed to this serve/bucket entry point
+                    exe = state["exe"] = jitted.lower(tenants,
+                                                      *panels).compile()
+                return exe(tenants, *panels)
+
+            return dispatch
+
+        return name, _streaming._cached_kernel(None, config, build,
+                                               name=name,
+                                               expected_signatures=1)
+
+    # ------------------------------------------------------------ serving
+
+    def serve(self, configs) -> list[TenantResult]:
+        """Validate, bucket, pad, dispatch, demux (module docs). Returns
+        one :class:`TenantResult` per submitted config, in order."""
+        configs = list(configs)
+        if not configs:
+            return []
+        normalized = []
+        for i, c in enumerate(configs):
+            if not isinstance(c, TenantConfig):
+                self._stats["rejected_configs"] += 1
+                raise ValueError(f"config {i} is not a TenantConfig "
+                                 f"(got {type(c).__name__})")
+            try:
+                c.validate(len(self.names), self.n_groups, self.n_dates)
+            except ValueError as e:
+                self._stats["rejected_configs"] += 1
+                raise ValueError(f"config {i} rejected before compile: "
+                                 f"{e}") from e
+            normalized.append(c.normalized(len(self.names), self.n_groups,
+                                           dtype=self._dtype))
+
+        buckets: dict = {}
+        for i, c in enumerate(normalized):
+            buckets.setdefault(c.static_key(), []).append(i)
+
+        results: list = [None] * len(configs)
+        top = self.pad_ladder[-1]
+        for skey, members in buckets.items():
+            self._buckets_seen.add(skey)
+            template = normalized[members[0]]
+            for lo in range(0, len(members), top):
+                chunk = members[lo:lo + top]
+                rung = _rung_for(len(chunk), self.pad_ladder)
+                pad = rung - len(chunk)
+                lanes = [normalized[i] for i in chunk]
+                lanes += [lanes[-1]] * pad  # discarded at demux
+                stacked = stack_configs(lanes)
+                name, exe = self._executable(skey, rung, template)
+                self._executables_seen.add(name)
+                out = exe(stacked, *self._panels)
+                self._stats["dispatches"] += 1
+                self._stats["configs_served"] += len(chunk)
+                self._stats["padded_lanes"] += pad
+                record_stage("serve/dispatch", kind="stage",
+                             entry_point=name, rung=rung,
+                             configs=len(chunk), padded_lanes=pad,
+                             bucket_count=len(self._buckets_seen))
+                for lane, i in enumerate(chunk):
+                    results[i] = TenantResult(
+                        index=i, config=configs[i],
+                        output=jax.tree_util.tree_map(
+                            lambda a, lane=lane: a[lane], out))
+        return results
+
+    # -------------------------------------------------------------- stats
+
+    def serving_stats(self) -> dict:
+        """streaming_cache_stats-style serving tallies: ``bucket_count``
+        (distinct signature buckets seen), ``executables`` ((bucket, rung)
+        entry points), dispatch/config/pad counts, the ladder, and the
+        shared kernel-cache counters the executables live in."""
+        return {"bucket_count": len(self._buckets_seen),
+                "executables": len(self._executables_seen),
+                **self._stats,
+                "pad_ladder": self.pad_ladder,
+                "kernel_cache": _streaming.streaming_cache_stats()}

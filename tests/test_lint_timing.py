@@ -79,6 +79,18 @@ def test_default_targets_cover_the_resil_layer_and_chaos_cli():
                           if p.parent.name == "tools"}
 
 
+def test_default_targets_cover_the_serving_layer():
+    """Round 14 extends the surface over factormodeling_tpu/serve/: the
+    front end's dispatch loop is a latency-claiming hot path (per-bucket
+    walls feed the SLO sketches via instrument_jit), exactly where an
+    unfenced throughput window would measure dispatch of a batched step
+    whose lanes haven't computed yet. Pinned by name so a future move out
+    of serve/ can't silently drop them from the linted surface."""
+    targets = lint_timing.default_targets(REPO)
+    serve = {p.name for p in targets if p.parent.name == "serve"}
+    assert {"frontend.py", "batched.py", "tenant.py"} <= serve
+
+
 def _lint_snippet(tmp_path, code):
     f = tmp_path / "snippet.py"
     f.write_text(textwrap.dedent(code))

@@ -1,0 +1,571 @@
+"""Many-tenant batched serving (``factormodeling_tpu.serve``,
+docs/architecture.md §20).
+
+Contract pinned here:
+
+- **compiles == bucket count**: a 1000-config sweep across 4 signature
+  buckets compiles exactly 4 executables, zero retrace-detector flags,
+  and a steady-state re-serve adds no compiles (the acceptance
+  criterion);
+- **per-tenant correctness**: sampled batched lanes match single-config
+  runs of the EXISTING pipeline (``build_research_step``) across an
+  equal/linear/mvo ladder — the acceptance bar is 1e-5, the observed
+  agreement is ~1e-12 (f64);
+- **selection parity bridge**: the traced rank-mask top-k reproduces the
+  static ``icir_top`` selection for every k in 1..F through ONE compiled
+  executable (the static path stays the single-config default);
+- **the hoisted prefix**: the selection metric context never batches —
+  no ``[C, F, D, N]`` operand exists in the optimized HLO;
+- **kernel-cache honesty**: a 1000-tenant sweep occupies ONE streaming-
+  LRU entry per bucket (no eviction churn), and ``bucket_count`` rides
+  ``serving_stats()``;
+- **validation before compile**: an invalid config raises at the front
+  end and never reaches trace/compile;
+- **pad-ladder semantics**: pad lanes are invisible — a config's result
+  is submission-set independent — and demux preserves order;
+- **per-bucket latency**: dispatches ride the PR 8 sketch machinery
+  (``RunReport(latency=True)`` -> ``serve/bucket/*`` latency rows).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from factormodeling_tpu import obs
+from factormodeling_tpu.parallel import build_research_step
+from factormodeling_tpu.parallel.streaming import (clear_streaming_cache,
+                                                   streaming_cache_stats)
+from factormodeling_tpu.selection import rolling_selection
+from factormodeling_tpu.serve import (
+    TenantConfig,
+    TenantServer,
+    make_batched_research_step,
+    make_tenant_research_step,
+    stack_configs,
+)
+
+F, D, N, WINDOW = 5, 30, 8, 6
+NAMES = ("fam0_f0_flx", "fam0_f1_eq", "fam1_f2_flx", "fam1_f3_long",
+         "fam2_f4_flx")
+
+
+def make_market(rng, *, d=D, n=N, f=F):
+    factors = rng.normal(size=(f, d, n))
+    factors[rng.uniform(size=factors.shape) < 0.05] = np.nan
+    return dict(
+        factors=factors,
+        returns=rng.normal(scale=0.02, size=(d, n)),
+        factor_ret=rng.normal(scale=0.01, size=(d, f)),
+        cap_flag=rng.integers(1, 4, size=(d, n)).astype(float),
+        investability=np.ones((d, n)),
+        universe=rng.uniform(size=(d, n)) > 0.05,
+    )
+
+
+def market_args(market):
+    return tuple(jnp.asarray(market[k]) for k in
+                 ("factors", "returns", "factor_ret", "cap_flag",
+                  "investability", "universe"))
+
+
+def serve_compile_stats():
+    return {k: v for k, v in obs.compile_stats().items()
+            if k.startswith("serve/bucket/")}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_kernel_cache():
+    """The serving executables live in the streaming kernel LRU (cap 16);
+    start this module from a clean cache so entry/eviction accounting is
+    exact, and leave it clean for later test modules."""
+    clear_streaming_cache()
+    yield
+    clear_streaming_cache()
+
+
+# ------------------------------------------ compiles == bucket count
+
+
+def test_thousand_config_sweep_compiles_once_per_bucket(rng):
+    """The acceptance criterion: 1000 configs across 4 signature buckets
+    -> 4 compiles (== bucket count, not config count), zero retraces, one
+    kernel-cache entry per bucket with no eviction churn, and a
+    steady-state re-serve that compiles nothing."""
+    market = make_market(rng)
+    server = TenantServer(names=NAMES, **market)
+    buckets = [
+        dict(method="equal", window=WINDOW),
+        dict(method="equal", window=WINDOW + 2),
+        dict(method="equal", window=WINDOW, blend_method="rank"),
+        dict(method="linear", window=WINDOW, max_weight=0.2),
+    ]
+    configs = [TenantConfig(top_k=1 + i % F, icir_threshold=-1.0,
+                            pct=0.1 + 0.02 * (i % 5),
+                            tcost_scale=0.5 + 0.1 * (i % 4),
+                            **buckets[i % len(buckets)])
+               for i in range(1000)]
+    before = {k: v["compiles"] for k, v in serve_compile_stats().items()}
+    cache0 = streaming_cache_stats()
+
+    results = server.serve(configs)
+    assert len(results) == 1000
+    assert all(r is not None and r.index == i
+               for i, r in enumerate(results))
+
+    stats = server.serving_stats()
+    assert stats["bucket_count"] == 4
+    assert stats["executables"] == 4  # each bucket fits one pad rung (512)
+    assert stats["configs_served"] == 1000
+
+    cs = serve_compile_stats()
+    new_compiles = sum(v["compiles"] - before.get(k, 0)
+                       for k, v in cs.items())
+    assert new_compiles == 4, cs  # compiles == bucket count
+    assert not any(v["retraced"] for v in cs.values()), cs
+
+    # kernel-cache honesty: one LRU entry per bucket, zero evictions
+    cache1 = streaming_cache_stats()
+    assert cache1["size"] - cache0["size"] == 4
+    assert cache1["evictions"] == cache0["evictions"]
+    assert cache1["misses"] - cache0["misses"] == 4
+
+    # steady state: the same traffic re-serves through the cached
+    # executables — cache hits only, not one fresh compile
+    server.serve(configs)
+    cs2 = serve_compile_stats()
+    assert sum(v["compiles"] - before.get(k, 0)
+               for k, v in cs2.items()) == 4
+    assert not any(v["retraced"] for v in cs2.values())
+    cache2 = streaming_cache_stats()
+    assert cache2["misses"] == cache1["misses"]
+    assert cache2["hits"] > cache1["hits"]
+    assert cache2["evictions"] == cache1["evictions"]
+
+    # a padded lane count consistent with the ladder: 250ish configs pad
+    # to the 512 rung per bucket, twice (two serves)
+    assert stats["padded_lanes"] > 0
+
+
+# ------------------------------------------- per-tenant correctness
+
+
+#: >= 8 sampled configs across an equal/linear/mvo ladder; the mvo cases
+#: keep the solver small (lookback 6, 50 iters) so the differential runs
+#: at tier-1 cost
+PARITY_LADDER = [
+    dict(top_k=2, icir_threshold=-1.0, max_weight=0.5, pct=0.3,
+         method="equal", window=WINDOW),
+    dict(top_k=1, icir_threshold=0.0, pct=0.15, method="equal",
+         window=WINDOW),
+    dict(top_k=5, icir_threshold=-1.0, pct=0.4, tcost_scale=1.7,
+         method="equal", window=WINDOW),
+    dict(top_k=3, icir_threshold=-1.0, pct=0.2, method="equal",
+         window=WINDOW, blend_method="rank"),
+    dict(top_k=2, icir_threshold=-1.0, max_weight=0.25, method="linear",
+         window=WINDOW),
+    dict(top_k=4, icir_threshold=0.01, max_weight=0.4, method="linear",
+         window=WINDOW, tcost_scale=0.0),
+    dict(top_k=2, icir_threshold=-1.0, max_weight=0.5, method="mvo",
+         window=WINDOW, lookback_period=6, return_weight=0.5,
+         sim_static=(("qp_iters", 50), ("mvo_batch", 8))),
+    dict(top_k=3, icir_threshold=-1.0, max_weight=0.5, method="mvo",
+         window=WINDOW, lookback_period=6, shrinkage_intensity=0.3,
+         turnover_penalty=0.0,
+         sim_static=(("qp_iters", 50), ("mvo_batch", 8))),
+]
+
+
+def test_batched_lanes_match_single_config_pipeline(rng):
+    """Acceptance: every sampled lane of the batched step matches a
+    single-config run of the EXISTING pipeline. Documented tolerance is
+    1e-5 where the traced rank-mask reformulation applies; observed (f64)
+    agreement is ~1e-12 — the paths are the same arithmetic, differently
+    fused."""
+    market = make_market(rng)
+    args = market_args(market)
+    server = TenantServer(names=NAMES, **market)
+    configs = [TenantConfig(**kw) for kw in PARITY_LADDER]
+    results = server.serve(configs)
+
+    for cfg, res in zip(configs, results):
+        ref_step = build_research_step(
+            names=NAMES, window=cfg.window,
+            select_method="icir_top",
+            select_kwargs=dict(top_x=int(cfg.top_k),
+                               icir_threshold=float(cfg.icir_threshold)),
+            blend_method=cfg.blend_method,
+            sim_kwargs=dict(method=cfg.method,
+                            max_weight=float(cfg.max_weight),
+                            pct=float(cfg.pct),
+                            lookback_period=cfg.lookback_period,
+                            shrinkage_intensity=float(
+                                cfg.shrinkage_intensity),
+                            turnover_penalty=float(cfg.turnover_penalty),
+                            return_weight=float(cfg.return_weight),
+                            tcost_scale=float(cfg.tcost_scale),
+                            **dict(cfg.sim_static)))
+        ref = jax.jit(ref_step)(*args)
+        lane = res.output
+        tag = f"{cfg.method}/{int(cfg.top_k)}"
+        np.testing.assert_allclose(np.asarray(lane.selection),
+                                   np.asarray(ref.selection),
+                                   atol=1e-5, err_msg=tag)
+        np.testing.assert_allclose(
+            np.nan_to_num(np.asarray(lane.signal)),
+            np.nan_to_num(np.asarray(ref.signal)), atol=1e-5, err_msg=tag)
+        np.testing.assert_allclose(
+            np.nan_to_num(np.asarray(lane.sim.weights)),
+            np.nan_to_num(np.asarray(ref.sim.weights)), atol=1e-5,
+            err_msg=tag)
+        np.testing.assert_allclose(
+            np.nan_to_num(np.asarray(lane.sim.result.log_return)),
+            np.nan_to_num(np.asarray(ref.sim.result.log_return)),
+            atol=1e-5, err_msg=tag)
+        np.testing.assert_allclose(
+            float(lane.summary.total_log_return),
+            float(ref.summary.total_log_return), atol=1e-5, err_msg=tag)
+        # the deterministic leg counts must agree exactly
+        np.testing.assert_array_equal(np.asarray(lane.sim.long_count),
+                                      np.asarray(ref.sim.long_count), tag)
+
+
+def test_deterministic_lanes_are_near_bitwise(rng):
+    """Where no solver is involved (equal scheme), the batched lane and
+    the single-config pipeline run the identical arithmetic — pin the
+    much tighter observed bar so a silent semantic drift can't hide
+    inside the 1e-5 acceptance tolerance."""
+    market = make_market(rng)
+    args = market_args(market)
+    server = TenantServer(names=NAMES, **market)
+    cfg = TenantConfig(top_k=2, icir_threshold=-1.0, pct=0.3,
+                       method="equal", window=WINDOW)
+    res = server.serve([cfg])[0]
+    ref = jax.jit(build_research_step(
+        names=NAMES, window=WINDOW,
+        select_kwargs=dict(top_x=2, icir_threshold=-1.0),
+        sim_kwargs=dict(method="equal", pct=0.3, tcost_scale=1.0)))(*args)
+    np.testing.assert_allclose(np.asarray(res.output.selection),
+                               np.asarray(ref.selection), atol=1e-12)
+    np.testing.assert_allclose(
+        np.nan_to_num(np.asarray(res.output.sim.weights)),
+        np.nan_to_num(np.asarray(ref.sim.weights)), atol=1e-12)
+
+
+# ------------------------------------------ selection parity bridge
+
+
+def test_selection_parity_bridge_every_k(rng):
+    """The traced rank-mask top-k against the static ``icir_top`` path
+    for EVERY k in 1..F — same data, one compiled executable serving all
+    k — so the reformulation cannot silently change research results.
+    The static path remains the single-config default
+    (build_research_step is untouched)."""
+    market = make_market(rng)
+    args = market_args(market)
+    template = TenantConfig(method="equal", window=WINDOW)
+    step = jax.jit(make_tenant_research_step(names=NAMES,
+                                             template=template))
+    compiled = {"n": 0}
+    for k in range(1, F + 1):
+        for th in (-1.0, 0.0, 0.02):
+            cfg = TenantConfig(top_k=k, icir_threshold=th, method="equal",
+                               window=WINDOW).normalized(F, 3)
+            out = step(cfg, *args)
+            static = rolling_selection(
+                args[0], args[1], args[2], WINDOW, method="icir_top",
+                method_kwargs=dict(top_x=k, icir_threshold=th),
+                universe=args[5])
+            np.testing.assert_allclose(np.asarray(out.selection),
+                                       np.asarray(static), atol=1e-12,
+                                       err_msg=f"k={k} th={th}")
+            compiled["n"] += 1
+    assert compiled["n"] == 3 * F  # every (k, threshold) through ONE jit
+    # and genuinely one executable: a jit sees one (shape, dtype)
+    # signature across all k — k is a VALUE, not a trace constant
+    assert step._cache_size() == 1
+
+
+# ---------------------------------------------- the hoisted prefix
+
+
+def test_selection_context_is_hoisted_out_of_the_vmap(rng):
+    """Structural pin on the hoisted prefix: the selection metric
+    context's rank sort — the [F, D, N] stack traversal that dominates a
+    single-config step — appears in the optimized HLO at its UNBATCHED
+    shape and NO sort ever touches a [C, F, D, N] operand. (The weighted
+    composite's preprocessed stack legitimately batches: its pooled
+    percentiles depend on the day's ACTIVE columns, which are
+    config-dependent — that is per-tenant work, not prefix.)"""
+    c = 7
+    market = make_market(rng)
+    args = market_args(market)
+    template = TenantConfig(method="equal", window=WINDOW)
+    step = make_batched_research_step(names=NAMES, template=template)
+    cfgs = [TenantConfig(top_k=1 + i % F, icir_threshold=-1.0,
+                         method="equal", window=WINDOW).normalized(F, 3)
+            for i in range(c)]
+    stacked = stack_configs(cfgs)
+    hlo = jax.jit(step).lower(stacked, *args).compile().as_text()
+    sort_lines = [ln for ln in hlo.splitlines() if "sort(" in ln]
+    assert sort_lines  # the metric stack's rank sort exists...
+    assert any(f"[{F},{D},{N}]" in ln for ln in sort_lines), sort_lines
+    # ...and never grew a config axis: a batched context would sort
+    # [C, F, D, N]
+    assert not any(f"[{c},{F},{D},{N}]" in ln for ln in sort_lines), \
+        [ln for ln in sort_lines if f"[{c},{F},{D},{N}]" in ln]
+
+
+# -------------------------------------------- kernel-cache honesty
+
+
+def test_tenant_load_occupies_one_cache_entry_per_bucket(rng):
+    """Satellite: the streaming ``_cached_kernel`` LRU (cap 16) keys on
+    static signatures, so a 1000-tenant sweep occupies ONE entry per
+    bucket — no eviction churn — and ``bucket_count`` is surfaced in the
+    ``streaming_cache_stats()``-style serving stats."""
+    market = make_market(rng, n=N + 1)  # distinct shapes -> fresh entries
+    server = TenantServer(names=NAMES, **market)
+    cache0 = streaming_cache_stats()
+    configs = [TenantConfig(top_k=1 + i % F, icir_threshold=-1.0,
+                            method="equal",
+                            window=WINDOW + (i % 2))  # 2 buckets
+               for i in range(1000)]
+    server.serve(configs)
+    cache1 = streaming_cache_stats()
+    assert cache1["size"] - cache0["size"] == 2
+    assert cache1["misses"] - cache0["misses"] == 2
+    assert cache1["evictions"] == cache0["evictions"]  # no churn
+    stats = server.serving_stats()
+    assert stats["bucket_count"] == 2
+    assert stats["kernel_cache"]["capacity"] == cache1["capacity"]
+
+
+# --------------------------------------- validation before compile
+
+
+@pytest.mark.parametrize("bad, match", [
+    (dict(top_k=0), "top_k"),
+    (dict(top_k=F + 1), "top_k"),
+    (dict(top_k=2.5), "integer"),
+    (dict(pct=0.0), "pct"),
+    (dict(pct=1.5), "pct"),
+    (dict(max_weight=np.nan), "max_weight"),
+    (dict(tcost_scale=-0.1), "tcost_scale"),
+    (dict(shrinkage_intensity=2.0), "shrinkage_intensity"),
+    (dict(manager_mix=np.zeros(F)), "manager_mix"),
+    (dict(manager_mix=np.ones(F - 1)), "manager_mix"),
+    (dict(blend_tilt=-np.ones(3)), "blend_tilt"),
+    (dict(window=D + 5), "window"),
+])
+def test_invalid_config_is_rejected_before_compile(rng, bad, match):
+    """Satellite: validation raises a clear ValueError at the front end
+    — BEFORE trace time — and the rejected config never reaches compile
+    (process compile totals unchanged, no serve entry point appears)."""
+    market = make_market(rng)
+    server = TenantServer(names=NAMES, **market)
+    kw = dict(top_k=2, method="equal", window=WINDOW)
+    kw.update(bad)
+    totals0 = obs.compile_totals()["compiles"]
+    entries0 = set(serve_compile_stats())
+    with pytest.raises(ValueError, match=match):
+        # obviously-bad scalars raise in the constructor, the rest at the
+        # front end's validate — both BEFORE any trace/compile
+        server.serve([TenantConfig(**kw)])
+    assert obs.compile_totals()["compiles"] == totals0
+    assert set(serve_compile_stats()) == entries0
+
+
+def test_constructor_rejects_what_it_can_immediately():
+    with pytest.raises(ValueError, match="method"):
+        TenantConfig(method="magic")
+    with pytest.raises(ValueError, match="top_k"):
+        TenantConfig(top_k=0)
+    with pytest.raises(ValueError, match="sim_static"):
+        TenantConfig(sim_static={"max_weight": 0.5})
+    # a TYPO'D static key must also die here, not as a raw TypeError at
+    # dispatch after other buckets already ran (found in review)
+    with pytest.raises(ValueError, match="sim_static"):
+        TenantConfig(sim_static={"qp_itersx": 10})
+    with pytest.raises(ValueError, match="bucket"):
+        stack_configs([TenantConfig(window=5).normalized(F, 3),
+                       TenantConfig(window=6).normalized(F, 3)])
+
+
+# ------------------------------------- pad ladder + demux semantics
+
+
+def test_pad_lanes_are_invisible_and_demux_preserves_order(rng):
+    """A config's result must not depend on its co-submissions: serving
+    [a, b, c] alone equals serving them inside a larger mixed batch (pad
+    lanes replicate a real config but are discarded at demux; vmapped
+    lanes cannot interact)."""
+    market = make_market(rng)
+    server = TenantServer(names=NAMES, **market)
+    trio = [TenantConfig(top_k=1 + i, icir_threshold=-1.0, method="equal",
+                         window=WINDOW, pct=0.1 + 0.05 * i)
+            for i in range(3)]
+    filler = [TenantConfig(top_k=1 + i % F, icir_threshold=-1.0,
+                           method="linear", max_weight=0.2, window=WINDOW)
+              for i in range(5)]
+    alone = server.serve(trio)
+    # interleave so demux must reorder across buckets
+    mixed = server.serve([filler[0], trio[0], filler[1], trio[1],
+                          filler[2], trio[2], filler[3], filler[4]])
+    for j, pos in enumerate((1, 3, 5)):
+        a, m = alone[j].output, mixed[pos].output
+        np.testing.assert_array_equal(np.asarray(a.selection),
+                                      np.asarray(m.selection))
+        np.testing.assert_array_equal(
+            np.nan_to_num(np.asarray(a.sim.weights)),
+            np.nan_to_num(np.asarray(m.sim.weights)))
+        assert mixed[pos].index == pos
+
+
+# ------------------------------- per-tenant knob semantics (new axes)
+
+
+def test_tcost_scale_zero_equals_costs_off(rng):
+    """tcost_scale=0 through the serving path reproduces the existing
+    ``transaction_cost=False`` pipeline bit-for-bit on net returns — the
+    per-tenant rate scale is a true generalization of the cost switch."""
+    market = make_market(rng)
+    args = market_args(market)
+    server = TenantServer(names=NAMES, **market)
+    res = server.serve([TenantConfig(top_k=2, icir_threshold=-1.0,
+                                     tcost_scale=0.0, method="equal",
+                                     window=WINDOW)])[0]
+    ref = jax.jit(build_research_step(
+        names=NAMES, window=WINDOW,
+        select_kwargs=dict(top_x=2, icir_threshold=-1.0),
+        sim_kwargs=dict(method="equal", transaction_cost=False)))(*args)
+    np.testing.assert_allclose(
+        np.nan_to_num(np.asarray(res.output.sim.result.log_return)),
+        np.nan_to_num(np.asarray(ref.sim.result.log_return)), atol=1e-12)
+
+
+def test_manager_mix_and_blend_tilt_semantics(rng):
+    """manager_mix splits capital among the day's selected factors (equal
+    mix == default selection exactly); blend_tilt reweights the prefix
+    groups (uniform tilt == untilted blend). Both live in the SAME bucket
+    as long as presence matches — one executable serves every mix."""
+    market = make_market(rng)
+    server = TenantServer(names=NAMES, **market)
+    base = dict(top_k=3, icir_threshold=-1.0, method="equal",
+                window=WINDOW)
+    uniform = TenantConfig(manager_mix=np.full(F, 0.7),
+                           blend_tilt=np.ones(3), **base)
+    skewed = TenantConfig(manager_mix=np.array([10.0, 1, 1, 1, 1]),
+                          blend_tilt=np.array([5.0, 1.0, 1.0]), **base)
+    plain = TenantConfig(**base)
+    assert uniform.static_key() == skewed.static_key()
+    assert uniform.static_key() != plain.static_key()  # presence differs
+    r_uni, r_skew = server.serve([uniform, skewed])
+    r_plain = server.serve([plain])[0]
+    # a uniform mix renormalizes away: identical to the mixless config
+    np.testing.assert_allclose(np.asarray(r_uni.output.selection),
+                               np.asarray(r_plain.output.selection),
+                               atol=1e-12)
+    np.testing.assert_allclose(
+        np.nan_to_num(np.asarray(r_uni.output.signal)),
+        np.nan_to_num(np.asarray(r_plain.output.signal)), atol=1e-12)
+    # the skewed mix actually moves the selection weights
+    sel_u = np.asarray(r_uni.output.selection)
+    sel_s = np.asarray(r_skew.output.selection)
+    active = sel_u.sum(1) > 0
+    assert np.abs(sel_u[active] - sel_s[active]).max() > 1e-3
+    # rows still normalize to 1 on active days
+    np.testing.assert_allclose(sel_s[active].sum(1), 1.0, atol=1e-12)
+
+
+def test_group_tilt_zeroing_every_active_group_zeroes_the_day(rng):
+    """Review finding: a tilt that zeroes the day's ONLY active group(s)
+    must zero that day's composite — the reference's equal-weight
+    fallback would silently restore full weight to the excluded group,
+    inverting the tenant's preference exactly where it binds. (Without a
+    tilt the fallback branch is unreachable: any active factor makes the
+    weight total positive — pinned by the untilted equality below.)"""
+    from factormodeling_tpu.composite import composite_weighted
+
+    names = ("a_f0_flx", "b_f1_flx")
+    factors = jnp.asarray(rng.normal(size=(2, 10, 6)))
+    # every day selects ONLY factor 1 (group b)
+    sel = jnp.asarray(np.tile([0.0, 1.0], (10, 1)))
+    untilted = composite_weighted(factors, names, sel)
+    ones_tilt = composite_weighted(factors, names, sel,
+                                   group_tilt=jnp.ones(2))
+    zeroing = composite_weighted(factors, names, sel,
+                                 group_tilt=jnp.asarray([1.0, 0.0]))
+    # a uniform tilt reproduces the untilted blend
+    np.testing.assert_allclose(np.asarray(ones_tilt),
+                               np.asarray(untilted), atol=1e-12)
+    assert np.abs(np.asarray(untilted)).max() > 0
+    # the excluded-group days are zeroed outright, not bounced back
+    np.testing.assert_array_equal(np.asarray(zeroing),
+                                  np.zeros_like(np.asarray(zeroing)))
+
+
+# ----------------------------------------- per-bucket latency + rows
+
+
+def test_dispatch_latency_rides_the_slo_sketches(rng):
+    """Satellite: the front end's dispatch is an instrument_jit entry
+    point, so with ``RunReport(latency=True)`` active every steady-state
+    dispatch's fenced wall lands in a ``serve/bucket/*`` quantile sketch
+    (compiling calls excluded — the PR 13 rule), and serve/dispatch
+    stage rows record rung/pad accounting."""
+    market = make_market(rng, d=D + 2)  # fresh entry points for this test
+    server = TenantServer(names=NAMES, **market)
+    cfgs = [TenantConfig(top_k=1 + i % F, icir_threshold=-1.0,
+                         method="equal", window=WINDOW) for i in range(3)]
+    rep = obs.RunReport("serve-latency", latency=True)
+    with rep.activate():
+        server.serve(cfgs)   # compiles: excluded from the sketch
+        server.serve(cfgs)   # steady state: recorded
+        server.serve(cfgs)
+    lat = [r for r in rep.latency_rows()
+           if r["name"].startswith("serve/bucket/")]
+    assert len(lat) == 1, rep.latency_rows()
+    assert lat[0]["count"] == 2
+    assert np.isfinite(lat[0]["p50_s"]) and lat[0]["p50_s"] > 0
+    dispatch_rows = [r for r in rep.rows if r["name"] == "serve/dispatch"]
+    assert len(dispatch_rows) == 3
+    assert all(r["rung"] == 8 and r["configs"] == 3 and
+               r["padded_lanes"] == 5 for r in dispatch_rows)
+    compile_rows = [r for r in rep.rows if r["kind"] == "compile"
+                    and r["name"].startswith("serve/bucket/")]
+    assert len(compile_rows) == 1  # one bucket, one compile
+
+
+# ------------------------------------------------- settings satellite
+
+
+def test_settings_tcost_scale_validation_and_elision():
+    """The settings-level mirror of the qp_anderson validation
+    precedent, plus the None-elision contract: no scale -> cost_rates
+    unchanged from the pre-round-14 table."""
+    from factormodeling_tpu.backtest import SimulationSettings
+
+    r = jnp.zeros((4, 3))
+    cap = jnp.ones((4, 3))
+    with pytest.raises(ValueError, match="tcost_scale"):
+        SimulationSettings(returns=r, cap_flag=cap,
+                           investability_flag=cap, tcost_scale=-0.5)
+    # numpy scalars are not python-float subclasses (np.float32) — the
+    # check must still catch them (found in review)
+    with pytest.raises(ValueError, match="tcost_scale"):
+        SimulationSettings(returns=r, cap_flag=cap,
+                           investability_flag=cap,
+                           tcost_scale=np.float32(-2.0))
+    s_none = SimulationSettings(returns=r, cap_flag=cap,
+                                investability_flag=cap)
+    s_one = SimulationSettings(returns=r, cap_flag=cap,
+                               investability_flag=cap, tcost_scale=1.0)
+    s_half = SimulationSettings(returns=r, cap_flag=cap,
+                                investability_flag=cap, tcost_scale=0.5)
+    np.testing.assert_array_equal(np.asarray(s_none.cost_rates()),
+                                  np.asarray(s_one.cost_rates()))
+    np.testing.assert_allclose(np.asarray(s_half.cost_rates()),
+                               0.5 * np.asarray(s_none.cost_rates()),
+                               atol=0)

@@ -231,7 +231,12 @@ def default_targets(repo_root=None) -> list[Path]:
     flows through and devtime.py/compile_log.py own perf_counter windows
     that MUST fence (the recorder's whole claim is fenced per-call
     latency) — pinned by name in the coverage test so a move out of
-    obs/ can't silently drop them."""
+    obs/ can't silently drop them. The serving layer joined with the
+    many-tenant round (round 14): the front end's dispatch loop is a
+    latency-claiming hot path (per-bucket walls feed the SLO sketches via
+    instrument_jit), exactly where an ad-hoc unfenced throughput window
+    would be tempting and wrong — the batched dispatch returns before a
+    single lane has computed."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))
@@ -240,6 +245,7 @@ def default_targets(repo_root=None) -> list[Path]:
             + sorted((pkg / "obs").glob("*.py"))
             + sorted((pkg / "ops").glob("_pallas_*.py"))
             + sorted((pkg / "resil").glob("*.py"))
+            + sorted((pkg / "serve").glob("*.py"))
             + sorted((pkg / "solvers").glob("*.py")))
 
 
