@@ -2062,6 +2062,146 @@ def bench_tenant_sweep(smoke=False, profile=False):
     return rows[0]
 
 
+# ------------------------------------------------- serving under load
+
+
+def bench_serving_under_load(smoke=False, profile=False):
+    """Sustained serving throughput UNDER OVERLOAD through the round-15
+    traffic layer (``serve/queue.py``, docs/architecture.md section 21):
+    a seeded Poisson arrival trace at ``load_factor`` x measured queue
+    capacity drains through ``TenantServer.serve_queued`` twice — once
+    with load-shedding OFF (unbounded queue: the collapse baseline) and
+    once ON (bounded queue, reject-new) — and the row publishes the
+    sustained served configs/s, the served-request p99, and the shed
+    rate of both runs against ONE declared ``SLOSpec`` budget.
+
+    The acceptance shape: with shedding OFF, overload grows the backlog
+    without bound and the served p99 (queueing delay included) blows
+    through the budget; with shedding ON the p99 meets it and the row
+    records the shed rate that bought it. Timing honesty (the section 21
+    note): the TRACE runs on the virtual clock with a constant
+    service-time model measured from a real fenced dispatch — every
+    quantity in the row is denominated in measured-service units, so the
+    OFF-violates / ON-meets verdict pair is machine-speed invariant,
+    while the published configs/s still scales with this container's
+    real dispatch wall (its best-of-N spread rides the row). Dispatches
+    execute REAL compute (the served outputs are the bit-identity anchor
+    of tests/test_serve_queue.py); only the seconds charged against
+    deadlines are modeled."""
+    from factormodeling_tpu.serve import TenantConfig, TenantServer
+    from factormodeling_tpu.serve.admission import AdmissionPolicy
+    from factormodeling_tpu.serve.queue import (VirtualClock,
+                                                make_requests,
+                                                poisson_arrivals)
+
+    f, d, n = (4, 30, 12) if smoke else (6, 120, 48)
+    n_requests = 24 if smoke else 160
+    window = 6 if smoke else 12
+    # 2x capacity: the backlog tail grows to ~n*(1 - 1/load)/top dispatch
+    # times, decisively past the 6x-service p99 budget at n=160 while the
+    # bounded queue holds the tail near 3x — machine-speed-invariant
+    # margins on BOTH sides of the verdict pair
+    load_factor = 2.0
+    ladder = (1, 4, 8)
+    top = ladder[-1]
+    rng = np.random.default_rng(23)
+    names = tuple(f"fam{i % 3}_f{i}_flx" for i in range(f))
+    panels = dict(
+        factors=rng.normal(size=(f, d, n)).astype(np.float32),
+        returns=rng.normal(scale=0.02, size=(d, n)).astype(np.float32),
+        factor_ret=rng.normal(scale=0.01, size=(d, f)).astype(np.float32),
+        cap_flag=rng.integers(1, 4, size=(d, n)).astype(np.float32),
+        investability=np.ones((d, n), np.float32))
+    server = TenantServer(names=names, pad_ladder=ladder, **panels)
+    configs = [TenantConfig(top_k=1 + i % f, icir_threshold=-1.0,
+                            method="equal", window=window, max_weight=0.5,
+                            pct=0.25 + 0.03 * (i % 3))
+               for i in range(n_requests)]
+
+    # measured constant service model: one warm top-rung dispatch, fenced
+    warm = configs[:top]
+
+    def serve_fenced():
+        res = server.serve(warm)
+        _fence(res[-1].output.summary.total_log_return)
+
+    t_service = _time_fn(serve_fenced, repeats=2 if smoke else 3)
+    service_s = float(t_service)
+    capacity_cps = top / service_s
+    rate_hz = load_factor * capacity_cps
+    deadline_s = 40 * service_s   # generous: late answers stay SERVED,
+    budget_s = 6 * service_s      # so the tight p99 budget does the judging
+    arrivals = poisson_arrivals(n_requests, rate_hz=rate_hz, seed=31)
+
+    def run(max_depth):
+        return server.serve_queued(
+            make_requests(configs, arrivals, deadline_s=deadline_s),
+            admission=AdmissionPolicy(max_depth=max_depth),
+            service_model=lambda _tag, _rung: service_s,
+            clock=VirtualClock(),
+            queue_name=f"serve/queue/shed_{'on' if max_depth else 'off'}")
+
+    with _profiled(profile, "serving_under_load"):
+        res_off = run(None)
+        res_on = run(8)
+
+    def p99(res):
+        v = res.counters.get("served_p99_s")
+        return float(v) if v is not None else float("nan")
+
+    p99_off, p99_on = p99(res_off), p99(res_on)
+    shed_rate_on = res_on.counters["shed_count"] / n_requests
+    shed_rate_off = res_off.counters["shed_count"] / n_requests
+    served_on = res_on.counters["served"]
+    makespan_on = res_on.clock_s
+    # the whole virtual timeline is proportional to the measured service
+    # unit, so each best-of-N service repeat maps to a throughput repeat
+    # without re-running the trace: rate_i = rate_best * t_best / t_i
+    sustained = _Timing(served_on / makespan_on,
+                        [served_on / makespan_on * service_s / t
+                         for t in t_service.times])
+    if not smoke:
+        assert p99_off > budget_s, (
+            f"shedding-OFF p99 {p99_off:.4f}s did not violate the "
+            f"{budget_s:.4f}s budget — the trace is not overloading "
+            f"(load {load_factor}x, service {service_s:.4f}s)")
+        assert p99_on <= budget_s, (
+            f"shedding-ON p99 {p99_on:.4f}s misses the declared budget "
+            f"{budget_s:.4f}s (shed rate {shed_rate_on:.2%})")
+        assert shed_rate_on > 0.0, "overloaded bounded queue shed nothing"
+
+    return _result(
+        f"serving_under_load_configs_per_sec_{f}f_{d}d_{n}assets",
+        sustained, unit="configs/s",
+        roofline_note=f"throughput row (bigger is better): sustained "
+                      f"served rate at {load_factor}x capacity WITH "
+                      f"load-shedding; virtual-clock trace denominated "
+                      f"in the measured per-dispatch service wall "
+                      f"(section 21 timing honesty note)",
+        extras={"value_is": f"served configs/sec sustained at "
+                            f"{load_factor}x capacity, shedding ON "
+                            f"(bounded depth 8)",
+                "load_factor": load_factor,
+                "capacity_configs_per_sec": round(capacity_cps, 4),
+                "service_s_measured": round(service_s, 6),
+                "service_spread": t_service.spread,
+                "deadline_s": round(deadline_s, 6),
+                "slo": {"scope": "serve/verdict/served", "quantile": 0.99,
+                        "budget_s": round(budget_s, 6),
+                        "p99_on_s": round(p99_on, 6),
+                        "p99_off_s": round(p99_off, 6),
+                        "violated_off": bool(p99_off > budget_s),
+                        "violated_on": bool(p99_on > budget_s)},
+                "shed_rate_on": round(shed_rate_on, 4),
+                "shed_rate_off": round(shed_rate_off, 4),
+                "counters_on": {k: int(v) for k, v in
+                                res_on.counters.items()
+                                if isinstance(v, int)},
+                "counters_off": {k: int(v) for k, v in
+                                 res_off.counters.items()
+                                 if isinstance(v, int)}})
+
+
 # --------------------------------------------- north star from DISK chunks
 
 
@@ -2210,6 +2350,7 @@ CONFIGS = {
     "obs_overhead": bench_obs_overhead,
     "daily_advance_p50_p99": bench_daily_advance,
     "tenant_sweep": bench_tenant_sweep,
+    "serving_under_load": bench_serving_under_load,
     "compat_pipeline": bench_compat_pipeline,
     "mvo_turnover": bench_mvo_turnover,
     "admm_iters_to_converge": bench_admm_iters_to_converge,

@@ -198,6 +198,35 @@ def _serving_demo(report, say) -> None:
         f"({stats['padded_lanes']} padded lanes), retraced: "
         f"{sorted(k for k, v in serve_cs.items() if v['retraced'])}")
 
+    # ---- loaded serving (the round-15 traffic layer, architecture §21):
+    # the SAME configs as bursty traffic above capacity on the virtual
+    # clock, through a bounded queue with the full degrade ladder — the
+    # kind="serving" verdict-count row lands in the report, where
+    # tools/trace_report.py --strict checks the counts sum and
+    # tools/report_diff.py gates shed/miss/retry growth
+    from factormodeling_tpu.serve.admission import AdmissionPolicy
+    from factormodeling_tpu.serve.queue import (bursty_arrivals,
+                                                make_requests)
+
+    service_s = 0.05  # constant virtual service model (demo determinism)
+    traffic = [configs[i % len(configs)] for i in range(24)]
+    # rate sized against the rung-8 executables the synchronous leg above
+    # already compiled, so the loaded leg adds traffic, not compiles
+    arrivals = bursty_arrivals(len(traffic), rate_hz=1.5 * 8 / service_s,
+                               burst=6, seed=9)
+    res = server.serve_queued(
+        make_requests(traffic, arrivals, deadline_s=8 * service_s),
+        admission=AdmissionPolicy(
+            max_depth=10,
+            ladder=("serve_stale", "cheap_fallback", "reject_new")),
+        service_model=lambda _tag, _rung: service_s)
+    c = res.counters
+    say(f"  loaded: {c['submitted']} requests at 1.5x capacity -> "
+        f"{c['served']} served / {c['shed_count']} shed / "
+        f"{c['deadline_miss_count']} missed / {c['failed_count']} failed "
+        f"({c['stale_served']} stale, {c['cheap_fallbacks']} "
+        f"cheap-fallback, {c['retry_count']} retries)")
+
 
 def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
                  window: int = 20, decay: int = 10, pct: float = 0.2,

@@ -56,6 +56,12 @@ flags):
   or honest skip) that vanished is a schema regression; per-stage
   device-second drift is informational (device clocks gate via the SLO/
   latency artifacts, not via one traced execution).
+- **serving** (request queue, round 15) — every baseline ``kind="serving"``
+  row must still exist, and its ``shed_count`` / ``deadline_miss_count`` /
+  ``retry_count`` / ``failed_count`` gate UP: under the same recorded
+  traffic, more shed or missed or retried requests means the serving
+  layer (or the hardware under it) got slower or flakier. Decreases and
+  other drift are informational; new serving rows are re-baseline notes.
 - **bench** — bench rows are invocation-dependent (configs are selected
   per run), so presence is never gated; but a seconds-valued bench row
   present in both reports gates its value at ``wall_ratio`` — against
@@ -85,13 +91,19 @@ from pathlib import Path
 __all__ = ["DiffResult", "Finding", "GATE_UP", "bench_rows", "comms_rows",
            "counter_scalars", "devtime_rows", "diff_reports",
            "latency_rows", "load_jsonl", "memory_rows", "meta_row",
-           "numerics_baseline", "sharding_rows", "span_totals"]
+           "numerics_baseline", "serving_rows", "sharding_rows",
+           "span_totals"]
 
 #: counter keys whose INCREASE is a regression (everything else drifts
 #: informationally). Nested mean/max counters gate on their "mean" leaf.
 #: ``degrade_events`` (resil.policy.DegradeStats): a healthy feed degrades
 #: nowhere, so a baseline-relative growth of quarantined/held/carried/
-#: clamped dates means the inputs (or the solver) got worse.
+#: clamped dates means the inputs (or the solver) got worse. The serving
+#: queue's bad-direction counts (shed_count/deadline_miss_count/
+#: retry_count/failed_count, round 15) gate through the dedicated
+#: ``kind="serving"`` section in :func:`diff_reports`, NOT through this
+#: tuple — they never appear in ``kind="counters"`` rows, and an
+#: endswith match here could accidentally gate an unrelated counter.
 GATE_UP = ("solver_fallback_days", "factor_nan_frac", "retraces",
            "turnover_suffix_len", "degrade_events")
 
@@ -251,6 +263,14 @@ def devtime_rows(rows) -> dict:
     (an honest skip is part of the schema a baseline pins)."""
     return {(r.get("name", ""), r.get("stage", "")): r for r in rows
             if r.get("kind") == "devtime" and "error" not in r}
+
+
+def serving_rows(rows) -> dict:
+    """name -> last serving-queue row (kind="serving"; the verdict-count
+    summary ``serve/queue.py`` emits, and the per-cell rows of the chaos
+    serving preset)."""
+    return {r.get("name", ""): r for r in rows
+            if r.get("kind") == "serving"}
 
 
 def bench_rows(rows) -> dict:
@@ -580,6 +600,33 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
             "devtime", f"{name}/{stg}",
             "device-time row present in baseline, missing in new report",
             regression=(stg == "total")))
+
+    # ---- serving rows: under the same recorded traffic, more shed /
+    # missed / failed requests or more dispatch retries is a regression
+    # in the bad direction (the serving layer got slower or flakier);
+    # drops and other field drift are informational, new rows note
+    base_sv, new_sv = serving_rows(base_rows), serving_rows(new_rows)
+    for name, base_row in sorted(base_sv.items()):
+        new_row = new_sv.get(name)
+        if new_row is None:
+            findings.append(Finding(
+                "serving", name, "serving row present in baseline, "
+                "missing in new report", regression=True))
+            continue
+        for key in ("shed_count", "deadline_miss_count", "retry_count",
+                    "failed_count"):
+            b, nv = base_row.get(key), new_row.get(key)
+            if not isinstance(b, (int, float)) \
+                    or not isinstance(nv, (int, float)) or nv == b:
+                continue
+            findings.append(Finding(
+                "serving", f"{name}/{key}",
+                f"{b:g} -> {nv:g} (delta {nv - b:+g})",
+                regression=nv > b))
+    for name in sorted(set(new_sv) - set(base_sv)):
+        findings.append(Finding(
+            "serving", name, "serving row absent from baseline (new "
+            "traffic leg) — re-baseline to gate it"))
 
     # ---- bench rows: seconds-valued rows gate at wall_ratio against the
     # spread-aware baseline; presence never gates (configs are selected

@@ -13,7 +13,12 @@ open at "detect":
   ``StageCounters`` into reports; the default policy is bit-inert.
 - :mod:`~factormodeling_tpu.resil.checkpoint` — versioned, checksummed,
   atomic snapshot/resume for the streaming chunk loop, the combo sweep,
-  and the chaos matrix, with retry/backoff host IO.
+  the chaos matrix, and the serving request queue, with retry/backoff
+  host IO.
+- :mod:`~factormodeling_tpu.resil.retry` — the shared bounded-backoff
+  combinator (promoted from ``checkpoint.io_retry``, round 15):
+  deterministic jitterless schedules, deadline awareness, and pluggable
+  clock/sleep so the serving queue can retry on its virtual timeline.
 
 ``tools/chaos.py`` drives the matrix: fault classes x policies, asserting
 finite P&L, dollar neutrality, weight/turnover bounds, and watchdog
@@ -30,12 +35,20 @@ from factormodeling_tpu.resil.checkpoint import (  # noqa: F401
     save_snapshot,
 )
 from factormodeling_tpu.resil.faults import (  # noqa: F401
+    DISPATCH_FAULT_CLASSES,
     FAULT_CLASSES,
     INJECT_STAGES,
+    DispatchFault,
+    DispatchFaultPlan,
     FaultSpec,
     inject,
     inject_universe,
     staleness_canary,
+)
+from factormodeling_tpu.resil.retry import (  # noqa: F401
+    DeadlineExceeded,
+    backoff_schedule,
+    retry_call,
 )
 from factormodeling_tpu.resil.policy import (  # noqa: F401
     DegradePolicy,

@@ -40,10 +40,11 @@ import json
 import os
 import sys
 import tempfile
-import time
 from pathlib import Path
 
 import numpy as np
+
+from factormodeling_tpu.resil.retry import retry_call
 
 __all__ = ["SNAPSHOT_VERSION", "Checkpointer", "SnapshotCorrupt",
            "fingerprint", "io_retry", "load_snapshot", "save_snapshot"]
@@ -86,14 +87,15 @@ def io_retry(fn, *, retries: int = 3, backoff: float = 0.05,
     errors. The LAST failure propagates — retry hides transient faults,
     not real ones — and ``no_retry`` exceptions propagate IMMEDIATELY
     (a deterministic condition like a missing snapshot is not a fault to
-    wait out)."""
-    for attempt in range(retries + 1):
-        try:
-            return fn()
-        except exceptions as e:
-            if isinstance(e, no_retry) or attempt == retries:
-                raise
-            time.sleep(backoff * (2 ** attempt))
+    wait out).
+
+    Thin delegate over the promoted shared combinator
+    (:func:`factormodeling_tpu.resil.retry.retry_call`, round 15) — kept
+    here so every existing import and test of the PR 7 surface keeps
+    working; new callers that need deadlines or a virtual clock should
+    use ``retry_call`` directly."""
+    return retry_call(fn, retries=retries, backoff=backoff,
+                      exceptions=exceptions, no_retry=no_retry)
 
 
 def _encode(tree, leaves: list):

@@ -59,7 +59,8 @@ import jax
 import jax.numpy as jnp
 from jax import random
 
-__all__ = ["FAULT_CLASSES", "INJECT_STAGES", "FaultSpec", "inject",
+__all__ = ["DISPATCH_FAULT_CLASSES", "FAULT_CLASSES", "INJECT_STAGES",
+           "DispatchFault", "DispatchFaultPlan", "FaultSpec", "inject",
            "inject_universe", "staleness_canary"]
 
 #: stage boundaries whose tensors the injectors can corrupt, in trace
@@ -149,6 +150,73 @@ class FaultSpec:
               "universe_collapse": {"collapse_rate": rate,
                                     "collapse_keep": keep}}[kind]
         return cls.make(seed=seed, stage=stage, **kw)
+
+
+# --------------------------------------------------- dispatch-level faults
+
+#: host-side fault classes the serving layer injects AROUND an executable
+#: dispatch (the six traced classes above corrupt tensors INSIDE the step;
+#: these kill or poison the dispatch itself, mid-drain):
+#: ``dispatch_error`` — the dispatch raises before delivering (an infra
+#: failure: preempted device, torn RPC); ``dispatch_poison`` — the
+#: dispatch completes but its outputs fail validation and must be
+#: discarded (a poisoned result is WORSE than an error: only an explicit
+#: output check catches it, which is why the queue treats it as a
+#: distinct class rather than folding it into errors).
+DISPATCH_FAULT_CLASSES = ("dispatch_error", "dispatch_poison")
+
+
+class DispatchFault(RuntimeError):
+    """An injected dispatch-level fault (see :data:`DISPATCH_FAULT_CLASSES`).
+    Retryable by design: the serving queue wraps every dispatch in
+    ``resil.retry.retry_call``, so a transient plan hit degrades to a
+    bounded backoff instead of a lost request."""
+
+    def __init__(self, kind: str, attempt: int):
+        super().__init__(f"injected {kind} at dispatch attempt {attempt}")
+        self.kind = kind
+        self.attempt = attempt
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchFaultPlan:
+    """Seedable host-side plan: which dispatch ATTEMPTS fault, and how.
+
+    Deterministic per attempt index (``numpy`` Philox keyed on
+    ``(seed, attempt)``), so a straight-through run and a killed/resumed
+    run — which restores its attempt counter from the snapshot — roll
+    identical faults, and re-running a chaos cell reproduces its exact
+    failure timeline. Rates are disjoint Bernoulli shares of one uniform
+    draw (``error_rate + poison_rate <= 1``), so raising one class's rate
+    never reshuffles the other's hits — the traced-fault lane discipline,
+    restated host-side. NOT a jax pytree: this plan lives in the host
+    scheduling loop and never enters a trace."""
+
+    seed: int = 0
+    error_rate: float = 0.0
+    poison_rate: float = 0.0
+
+    def __post_init__(self):
+        for name in ("error_rate", "poison_rate"):
+            v = float(getattr(self, name))
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.error_rate + self.poison_rate > 1.0:
+            raise ValueError(
+                f"error_rate + poison_rate must be <= 1 (disjoint shares "
+                f"of one draw), got {self.error_rate} + {self.poison_rate}")
+
+    def roll(self, attempt: int) -> "str | None":
+        """The fault class injected at this attempt index, or None."""
+        import numpy as np
+
+        u = float(np.random.default_rng(
+            (int(self.seed), int(attempt))).uniform())
+        if u < self.error_rate:
+            return "dispatch_error"
+        if u < self.error_rate + self.poison_rate:
+            return "dispatch_poison"
+        return None
 
 
 def _key(spec: FaultSpec, stage_idx: int, kind: str):

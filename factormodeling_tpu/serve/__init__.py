@@ -17,6 +17,16 @@ configurations per dispatch:
   request-batching front end (validate -> bucket -> pad-ladder -> AOT
   dispatch through the streaming kernel LRU -> demux) with
   ``serve/bucket/...`` compile/latency telemetry.
+- :mod:`~factormodeling_tpu.serve.queue` /
+  :mod:`~factormodeling_tpu.serve.admission` — the round-15 traffic
+  layer (architecture.md §21): virtual-clock request queue with
+  seedable Poisson/bursty arrival traces, deadline-aware micro-batching
+  over the pad ladder, admission control with a shed/degrade ladder,
+  retried fault-tolerant dispatch, and checkpoint/resume — every
+  request terminates in exactly one of SERVED/SHED/DEADLINE_MISS/FAILED
+  (``TenantServer.serve_queued``). Imported LAZILY (PEP 562 below): the
+  default synchronous path never loads these modules, the structural-
+  elision contract pinned in tests/test_serve_queue.py.
 """
 
 from factormodeling_tpu.serve.batched import (  # noqa: F401
@@ -32,3 +42,30 @@ from factormodeling_tpu.serve.tenant import (  # noqa: F401
     TenantConfig,
     stack_configs,
 )
+
+#: traffic-layer names resolved lazily from their modules — importing
+#: ``factormodeling_tpu.serve`` must NOT pull the queue/admission code
+#: the default synchronous path structurally elides
+_LAZY = {
+    "queue": ("DEADLINE_MISS", "FAILED", "SERVED", "SHED", "VERDICTS",
+              "DispatchEstimator", "QueueResult", "Request", "VirtualClock",
+              "bursty_arrivals", "make_requests", "poisson_arrivals",
+              "run_queued"),
+    "admission": ("AdmissionPolicy", "LADDER_STEPS", "StaleCache"),
+}
+_LAZY_NAME_TO_MOD = {name: mod for mod, names in _LAZY.items()
+                     for name in names}
+
+
+def __getattr__(name):
+    mod = _LAZY_NAME_TO_MOD.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_NAME_TO_MOD))

@@ -42,6 +42,13 @@ the donated buffers are never reused. The market panels are NOT donated:
 one server serves many buckets and many dispatches from the same panel
 buffers, and donating them would invalidate the inputs after the first
 dispatch (docs/architecture.md section 20's honest-limits note).
+
+Under load, :meth:`TenantServer.serve_queued` runs the SAME pad/dispatch
+machinery beneath the round-15 traffic layer — async request queue,
+deadline-aware rung choice, admission control/load-shedding, retried
+dispatch, checkpoint/resume (``serve/queue.py``, architecture §21). The
+queue modules import lazily, so this default synchronous path stays
+structurally identical to a build without them.
 """
 
 from __future__ import annotations
@@ -99,9 +106,20 @@ class TenantServer:
                  investability, universe=None,
                  pad_ladder=DEFAULT_PAD_LADDER, donate_configs=None):
         self.names = tuple(names)
-        ladder = tuple(sorted(set(int(r) for r in pad_ladder)))
-        if not ladder or ladder[0] < 1:
-            raise ValueError(f"pad_ladder must hold positive sizes, "
+        # validated, not normalized: silently sorting/deduping a
+        # user-supplied ladder would hide a config error (a descending or
+        # duplicated ladder is a typo, not a preference) — reject it with
+        # the reason BEFORE anything traces
+        ladder = tuple(pad_ladder)
+        if not ladder:
+            raise ValueError("pad_ladder must hold at least one rung")
+        if any(int(r) != r or int(r) < 1 for r in ladder):
+            raise ValueError(f"pad_ladder rungs must be positive "
+                             f"integers, got {pad_ladder!r}")
+        ladder = tuple(int(r) for r in ladder)
+        if any(b <= a for a, b in zip(ladder, ladder[1:])):
+            raise ValueError(f"pad_ladder must be strictly ascending "
+                             f"(no duplicate or out-of-order rungs), "
                              f"got {pad_ladder!r}")
         self.pad_ladder = ladder
         self._panels = tuple(
@@ -127,14 +145,23 @@ class TenantServer:
 
     # ------------------------------------------------------- executables
 
+    def _entry_key(self, skey, rung: int) -> tuple:
+        shapes = tuple(None if a is None else
+                       (tuple(a.shape), str(a.dtype)) for a in self._panels)
+        return ("serve", self.names, skey, rung, shapes)
+
+    def entry_name(self, skey, rung: int) -> str:
+        """The stable per-(bucket, rung) entry-point name — the key under
+        which compile rows and latency sketches accumulate, and the name
+        the serving queue's estimator seeds from a PR 8 artifact."""
+        return f"serve/bucket/{entry_point_tag(self._entry_key(skey, rung))}"
+
     def _executable(self, skey, rung: int, template: TenantConfig):
         """One AOT executable per (bucket, rung), via the streaming kernel
         LRU — the cache key is value-based (static residue + rung + panel
         shapes/dtypes), so equal-market servers share executables and the
         cache stays one entry per bucket under any tenant count."""
-        shapes = tuple(None if a is None else
-                       (tuple(a.shape), str(a.dtype)) for a in self._panels)
-        config = ("serve", self.names, skey, rung, shapes)
+        config = self._entry_key(skey, rung)
         name = f"serve/bucket/{entry_point_tag(config)}"
 
         def build():
@@ -163,6 +190,47 @@ class TenantServer:
 
     # ------------------------------------------------------------ serving
 
+    def _normalize(self, c) -> TenantConfig:
+        """Validate one config against this server's market (raising the
+        front end's clear ValueError) and return it normalized to the
+        panels' dtype — shared by the synchronous path and the queue."""
+        if not isinstance(c, TenantConfig):
+            self._stats["rejected_configs"] += 1
+            raise ValueError(f"config is not a TenantConfig "
+                             f"(got {type(c).__name__})")
+        try:
+            c.validate(len(self.names), self.n_groups, self.n_dates)
+        except ValueError:
+            self._stats["rejected_configs"] += 1
+            raise
+        return c.normalized(len(self.names), self.n_groups,
+                            dtype=self._dtype)
+
+    def _dispatch_padded(self, skey, rung: int, lanes, template):
+        """Pad ``lanes`` (already-normalized same-bucket configs) up to
+        ``rung``, dispatch the bucket's AOT executable, and tally the
+        serving stats. Returns ``(entry_name, stacked_output,
+        padded_lanes)`` — the demux (and its row recording) stays with
+        the caller, so the synchronous row shape is untouched by the
+        queue sharing this path.
+
+        ``serving_stats()`` counts EXECUTIONS: under the queue's retry
+        wrapper a poisoned-then-retried dispatch runs this twice for one
+        logical dispatch, so these tallies can legitimately exceed the
+        queue's ``kind="serving"`` row (which counts logical dispatches
+        and delivered verdicts) by exactly the faulted attempts."""
+        self._buckets_seen.add(skey)
+        pad = rung - len(lanes)
+        lanes = list(lanes) + [lanes[-1]] * pad  # discarded at demux
+        stacked = stack_configs(lanes)
+        name, exe = self._executable(skey, rung, template)
+        self._executables_seen.add(name)
+        out = exe(stacked, *self._panels)
+        self._stats["dispatches"] += 1
+        self._stats["configs_served"] += rung - pad
+        self._stats["padded_lanes"] += pad
+        return name, out, pad
+
     def serve(self, configs) -> list[TenantResult]:
         """Validate, bucket, pad, dispatch, demux (module docs). Returns
         one :class:`TenantResult` per submitted config, in order."""
@@ -171,18 +239,11 @@ class TenantServer:
             return []
         normalized = []
         for i, c in enumerate(configs):
-            if not isinstance(c, TenantConfig):
-                self._stats["rejected_configs"] += 1
-                raise ValueError(f"config {i} is not a TenantConfig "
-                                 f"(got {type(c).__name__})")
             try:
-                c.validate(len(self.names), self.n_groups, self.n_dates)
+                normalized.append(self._normalize(c))
             except ValueError as e:
-                self._stats["rejected_configs"] += 1
                 raise ValueError(f"config {i} rejected before compile: "
                                  f"{e}") from e
-            normalized.append(c.normalized(len(self.names), self.n_groups,
-                                           dtype=self._dtype))
 
         buckets: dict = {}
         for i, c in enumerate(normalized):
@@ -191,21 +252,13 @@ class TenantServer:
         results: list = [None] * len(configs)
         top = self.pad_ladder[-1]
         for skey, members in buckets.items():
-            self._buckets_seen.add(skey)
             template = normalized[members[0]]
             for lo in range(0, len(members), top):
                 chunk = members[lo:lo + top]
                 rung = _rung_for(len(chunk), self.pad_ladder)
-                pad = rung - len(chunk)
                 lanes = [normalized[i] for i in chunk]
-                lanes += [lanes[-1]] * pad  # discarded at demux
-                stacked = stack_configs(lanes)
-                name, exe = self._executable(skey, rung, template)
-                self._executables_seen.add(name)
-                out = exe(stacked, *self._panels)
-                self._stats["dispatches"] += 1
-                self._stats["configs_served"] += len(chunk)
-                self._stats["padded_lanes"] += pad
+                name, out, pad = self._dispatch_padded(skey, rung, lanes,
+                                                       template)
                 record_stage("serve/dispatch", kind="stage",
                              entry_point=name, rung=rung,
                              configs=len(chunk), padded_lanes=pad,
@@ -216,6 +269,21 @@ class TenantServer:
                         output=jax.tree_util.tree_map(
                             lambda a, lane=lane: a[lane], out))
         return results
+
+    def serve_queued(self, requests, **kwargs):
+        """Drain :class:`~factormodeling_tpu.serve.queue.Request`s through
+        the traffic layer — async queue, deadline-aware batching,
+        admission control, load-shedding, fault-tolerant dispatch, and
+        checkpoint/resume (``serve/queue.py`` module docs; returns its
+        :class:`~factormodeling_tpu.serve.queue.QueueResult`).
+
+        Imported lazily: the default synchronous :meth:`serve` path never
+        touches the queue/admission modules (structural elision, pinned
+        in tests/test_serve_queue.py — the PR 7 unimportable-module
+        contract restated for the traffic layer)."""
+        from factormodeling_tpu.serve.queue import run_queued
+
+        return run_queued(self, requests, **kwargs)
 
     # -------------------------------------------------------------- stats
 

@@ -82,11 +82,76 @@ def test_resume_preserves_caller_report_rows(tmp_path):
                for r in rows) == 1
 
 
+def test_serving_preset_smoke():
+    """The round-15 serving matrix: dispatch faults x admission policies
+    against a loaded queue — every request verdicts, clean cells never
+    FAIL, the open policy never sheds, bounded policies visibly shed or
+    degrade under overload, and served outputs hold the production
+    invariants."""
+    verdict = chaos.run_serving_chaos(
+        shape=(4, 30, 12), window=5, method="linear",
+        faults=["none", "dispatch_error"],
+        policies=["open", "bounded", "degrade"],
+        n_requests=18, seed=1, progress=lambda _m: None)
+    assert verdict["cells"] == 6
+    assert verdict["ok"], verdict["failed"]
+    open_clean = verdict["results"]["serving/none/open"]
+    assert open_clean["served"] == 18 and open_clean["shed_count"] == 0
+    bounded = verdict["results"]["serving/none/bounded"]
+    assert bounded["shed_count"] > 0
+    degrade = verdict["results"]["serving/none/degrade"]
+    assert degrade["stale_served"] + degrade["cheap_fallbacks"] \
+        + degrade["shed_count"] > 0
+
+
 CLI = [sys.executable, str(REPO / "tools" / "chaos.py"),
        "--shape", "4,24,10", "--window", "6", "--method", "equal",
        "--faults", "nan_burst,universe_collapse", "--policies",
        "default,guard", "--rate", "0.08", "--day-rate", "0.25",
        "--seed", "5", "--json"]
+
+SERVING_CLI = [sys.executable, str(REPO / "tools" / "chaos.py"),
+               "--serving", "--shape", "4,30,12", "--window", "5",
+               "--method", "linear", "--faults", "none,dispatch_error",
+               "--policies", "bounded,degrade", "--requests", "18",
+               "--seed", "1", "--json"]
+
+
+def test_serving_cli_kill_resume_differential(tmp_path):
+    """Satellite: the queue checkpoint/resume differential end to end
+    over the real CLI — a server killed BETWEEN DISPATCHES
+    (``_FMT_SERVE_DIE_AFTER_DISPATCH``, the ``_FMT_CHAOS_DIE_AFTER_CELL``
+    pattern one level down) resumes from its snapshot with no
+    double-served and no lost request: the final verdict JSON is
+    byte-equal to a straight-through run."""
+    env = {**os.environ}
+    straight = subprocess.run(SERVING_CLI, capture_output=True, text=True,
+                              env=env, timeout=420)
+    assert straight.returncode == 0, straight.stderr[-2000:]
+
+    ck = tmp_path / "serving.ckpt"
+    killed = subprocess.run(
+        SERVING_CLI + ["--checkpoint", str(ck)], capture_output=True,
+        text=True, timeout=420,
+        env={**env, "_FMT_SERVE_DIE_AFTER_DISPATCH": "2"})
+    assert killed.returncode == 137, killed.stderr[-2000:]
+    assert "dying after dispatch 2" in killed.stdout
+
+    report = tmp_path / "resumed.jsonl"
+    resumed = subprocess.run(
+        SERVING_CLI + ["--checkpoint", str(ck), "--report", str(report)],
+        capture_output=True, text=True, env=env, timeout=420)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    assert resumed.stdout == straight.stdout  # byte-equal verdict JSON
+    verdict = json.loads(resumed.stdout)
+    assert verdict["ok"] and verdict["cells"] == 4
+    # the resumed report CONTINUES the killed run: every cell's serving
+    # row present exactly once, the resumed-skipped cells' rows restored
+    # from the snapshot (review finding: they used to be silently lost)
+    rows = [json.loads(line) for line in report.read_text().splitlines()]
+    cell_rows = [r["name"] for r in rows if r.get("kind") == "serving"
+                 and r["name"].startswith("serving/")]
+    assert sorted(cell_rows) == sorted(verdict["results"])
 
 
 def _run(extra, env_extra=None, timeout=420):

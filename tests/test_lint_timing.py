@@ -85,10 +85,19 @@ def test_default_targets_cover_the_serving_layer():
     walls feed the SLO sketches via instrument_jit), exactly where an
     unfenced throughput window would measure dispatch of a batched step
     whose lanes haven't computed yet. Pinned by name so a future move out
-    of serve/ can't silently drop them from the linted surface."""
+    of serve/ can't silently drop them from the linted surface.
+
+    Round 15 adds the traffic layer by name: queue.py's whole claim is
+    that scheduling time is VIRTUAL (an ambient perf_counter window there
+    would silently re-couple verdicts to host jitter), admission.py rides
+    the same glob, and resil/retry.py owns sleeps that sit exactly where
+    a careless wall-clock window would land."""
     targets = lint_timing.default_targets(REPO)
     serve = {p.name for p in targets if p.parent.name == "serve"}
-    assert {"frontend.py", "batched.py", "tenant.py"} <= serve
+    assert {"frontend.py", "batched.py", "tenant.py",
+            "queue.py", "admission.py"} <= serve
+    resil = {p.name for p in targets if p.parent.name == "resil"}
+    assert "retry.py" in resil
 
 
 def _lint_snippet(tmp_path, code):

@@ -24,12 +24,26 @@ the same executable. Results land as ``kind="degrade"`` RunReport rows
 ``--checkpoint`` the matrix loop snapshots after every cell
 (``resil.checkpoint``) and resumes bit-equal — kill it mid-run and rerun.
 
+``--serving`` switches to the round-15 SERVING preset: each cell runs a
+dispatch-fault plan x admission policy against a LOADED request queue
+(``serve/queue.py`` — bursty arrivals above capacity on the virtual
+clock) instead of a single research step, asserting that every submitted
+request terminates in exactly one verdict (counts sum to submissions),
+that clean cells never FAIL a request, that bounded policies actually
+shed/degrade under overload while the open policy sheds nothing, and
+that served outputs still satisfy the production invariants above. With
+``--checkpoint`` the cell loop AND each cell's queue snapshot after
+every dispatch; the ``_FMT_SERVE_DIE_AFTER_DISPATCH`` env hook kills the
+process mid-drain and a rerun resumes byte-equal (the kill/resume
+differential in tests/test_serve_queue.py).
+
 Usage::
 
     python tools/chaos.py [--shape F,D,N] [--window 8]
         [--method mvo_turnover] [--faults all|csv] [--policies all|csv]
         [--rate 0.05] [--day-rate 0.2] [--seed 0] [--tol 0.05]
         [--report chaos_report.jsonl] [--checkpoint chaos.ckpt] [--json]
+        [--serving] [--requests 24] [--load 1.5]
 
 Exit codes: 0 = every cell satisfied every invariant; 1 = at least one
 violation (each printed with its cell and invariant); 2 = bad usage.
@@ -272,6 +286,189 @@ def run_chaos(*, shape=(6, 48, 16), window: int = 8,
             "results": {k: done[k] for k in sorted(done)}}
 
 
+# ------------------------------------------------------ the serving preset
+
+#: dispatch-fault plans of the serving matrix (``resil.DispatchFaultPlan``
+#: rates; "none" is the clean column every policy must pass un-degraded)
+SERVING_FAULTS = ("none", "dispatch_error", "dispatch_poison",
+                  "dispatch_flaky")
+
+#: admission policies of the serving matrix: "open" = unbounded (the
+#: collapse baseline — it must still verdict everything), "bounded" =
+#: depth-capped pure shedding, "degrade" = the full ladder
+#: (serve-stale -> cheapest-method -> reject-new)
+SERVING_POLICIES = ("open", "bounded", "degrade")
+
+
+def _serving_fault_plan(resil, kind: str, seed: int):
+    rates = {"none": None,
+             "dispatch_error": dict(error_rate=0.3),
+             "dispatch_poison": dict(poison_rate=0.3),
+             "dispatch_flaky": dict(error_rate=0.2, poison_rate=0.2)}[kind]
+    return None if rates is None else resil.DispatchFaultPlan(seed=seed,
+                                                              **rates)
+
+
+def _serving_policy(admission, kind: str, depth: int):
+    if kind == "open":
+        return admission.AdmissionPolicy(max_depth=None)
+    if kind == "bounded":
+        return admission.AdmissionPolicy(max_depth=depth)
+    return admission.AdmissionPolicy(
+        max_depth=depth,
+        ladder=("serve_stale", "cheap_fallback", "reject_new"))
+
+
+def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
+                      method: str = "linear", faults=None, policies=None,
+                      n_requests: int = 24, load_factor: float = 1.5,
+                      seed: int = 0, tol: float = 0.05, report=None,
+                      checkpoint_path=None, checkpoint_every: int = 1,
+                      progress=print) -> dict:
+    """The serving matrix (module docs): dispatch-fault plan x admission
+    policy over a loaded queue. Returns the same JSON-ready verdict shape
+    as :func:`run_chaos`. Importable for the tier-1 smoke."""
+    from factormodeling_tpu import obs, resil
+    from factormodeling_tpu.serve import TenantConfig, TenantServer
+    from factormodeling_tpu.serve import admission as serve_admission
+    from factormodeling_tpu.serve.queue import bursty_arrivals, make_requests
+
+    f, d, n = shape
+    names, args = make_inputs(f, d, n, seed=seed)
+    panels = dict(zip(("factors", "returns", "factor_ret", "cap_flag",
+                       "investability", "universe"), args))
+    faults = list(faults or SERVING_FAULTS)
+    unknown = set(faults) - set(SERVING_FAULTS)
+    if unknown:
+        raise ValueError(f"unknown serving fault kinds {sorted(unknown)}; "
+                         f"valid: {SERVING_FAULTS}")
+    policies = list(policies or SERVING_POLICIES)
+    unknown = set(policies) - set(SERVING_POLICIES)
+    if unknown:
+        raise ValueError(f"unknown serving policies {sorted(unknown)}; "
+                         f"valid: {SERVING_POLICIES}")
+
+    ladder = (1, 4, 8)
+    depth = 10
+    service_s = 0.05  # virtual seconds per dispatch (constant model)
+    rate_hz = load_factor * ladder[-1] / service_s
+    # pct/max_weight sized so a leg can always normalize to +-1 on this
+    # small panel (a binding cap is a config property, not a serving
+    # fault — the leg-sum invariant must judge the QUEUE, not the sizing)
+    configs = [TenantConfig(top_k=1 + i % f, icir_threshold=-1.0,
+                            method=method, window=window, max_weight=0.5,
+                            pct=0.25 + 0.03 * (i % 3))
+               for i in range(n_requests)]
+
+    rep = report if report is not None else obs.RunReport("chaos-serving")
+    cells = [(fk, pk) for fk in faults for pk in policies]
+    done: dict = {}
+    ck = None
+    ck_meta = {"entry": "chaos-serving",
+               "config": [list(shape), window, method, faults, policies,
+                          int(n_requests), float(load_factor), int(seed),
+                          float(tol)]}
+    with rep.activate():
+        # resume replacement slices from here, exactly like run_chaos: a
+        # resumed run's report must CONTINUE the killed run's rows (the
+        # skipped cells' serving rows come from the snapshot, so a
+        # --report artifact never loses pre-kill cells), while rows a
+        # caller recorded before us stay put
+        mark = len(rep.rows)
+        if checkpoint_path is not None:
+            ck = resil.Checkpointer(checkpoint_path, every=checkpoint_every)
+            got = ck.resume(expect_meta=ck_meta)
+            if got is not None:
+                state, _ = got
+                done = {k: json.loads(v) for k, v in state["done"].items()}
+                rep.rows[mark:] = [json.loads(row)
+                                   for row in state.get("report_rows", [])]
+                progress(f"chaos-serving: resumed {len(done)}/{len(cells)} "
+                         f"cells from {checkpoint_path}")
+        for idx, (fault, pol_name) in enumerate(cells):
+            cell = f"serving/{fault}/{pol_name}"
+            if cell in done:
+                continue
+            server = TenantServer(names=names, pad_ladder=ladder, **panels)
+            arrivals = bursty_arrivals(n_requests, rate_hz=rate_hz,
+                                       burst=6, seed=seed + idx)
+            requests = make_requests(configs, arrivals,
+                                     deadline_s=8 * service_s)
+            cell_ck = (None if checkpoint_path is None
+                       else f"{checkpoint_path}.cell{idx}")
+            res = server.serve_queued(
+                requests,
+                admission=_serving_policy(serve_admission, pol_name, depth),
+                service_model=lambda _tag, _rung: service_s,
+                fault_plan=_serving_fault_plan(resil, fault, seed + idx),
+                retries=2, checkpoint_path=cell_ck)
+
+            c = res.counters
+            violations: list[str] = []
+            by_rid = res.by_rid()
+            if sorted(by_rid) != list(range(n_requests)):
+                violations.append("verdict completeness: not every rid "
+                                  "got exactly one verdict")
+            total = (c["served"] + c["shed_count"]
+                     + c["deadline_miss_count"] + c["failed_count"])
+            if total != n_requests:
+                violations.append(f"verdict counts sum {total} != "
+                                  f"{n_requests} submissions")
+            if fault == "none" and c["failed_count"]:
+                violations.append(f"{c['failed_count']} FAILED requests "
+                                  f"with no fault injected")
+            if pol_name == "open" and c["shed_count"]:
+                violations.append("the unbounded policy shed requests")
+            if pol_name != "open" and not (
+                    c["shed_count"] + c["stale_served"]
+                    + c["cheap_fallbacks"]):
+                violations.append("bounded policy neither shed nor "
+                                  "degraded under overload")
+            checked = 0
+            for v in res.verdicts:
+                if v["verdict"] != "SERVED" or v["dispatch"] is None \
+                        or v["rid"] not in res.outputs:
+                    # stale serves reuse an already-checked book, and a
+                    # RESUMED cell's pre-kill outputs were delivered (and
+                    # judged) by the killed process — verdicts are the
+                    # durable artifact, outputs are not re-materialized
+                    continue
+                violations.extend(
+                    f"rid {v['rid']}: {msg}" for msg in
+                    check_invariants(res.outputs[v["rid"]], tol=tol))
+                checked += 1
+                if checked >= 4:
+                    break
+            result = {"fault": fault, "policy": pol_name,
+                      "ok": not violations, "violations": violations,
+                      **{k: int(c[k]) for k in
+                         ("submitted", "served", "shed_count",
+                          "deadline_miss_count", "failed_count",
+                          "retry_count", "rung_downgrades", "stale_served",
+                          "cheap_fallbacks", "dispatches")}}
+            rep.record(cell, kind="serving", **result)
+            done[cell] = result
+            progress(f"{cell}: {'ok' if result['ok'] else 'FAIL'} "
+                     f"(served={c['served']} shed={c['shed_count']} "
+                     f"miss={c['deadline_miss_count']} "
+                     f"failed={c['failed_count']} "
+                     f"retries={c['retry_count']})")
+            if ck is not None:
+                ck.maybe_save(
+                    idx,
+                    {"done": {k: json.dumps(v, sort_keys=True)
+                              for k, v in done.items()},
+                     "report_rows": [json.dumps(r, sort_keys=True,
+                                                default=str)
+                                     for r in rep.rows[mark:]]},
+                    meta=ck_meta)
+
+    failures = {k: v for k, v in done.items() if not v["ok"]}
+    return {"ok": not failures, "cells": len(cells),
+            "failed": sorted(failures),
+            "results": {k: done[k] for k in sorted(done)}}
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -300,6 +497,15 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-every", type=int, default=1)
     parser.add_argument("--json", action="store_true",
                         help="emit the verdict as one JSON object")
+    parser.add_argument("--serving", action="store_true",
+                        help="run the SERVING preset: dispatch-fault x "
+                             "admission-policy cells against a loaded "
+                             "request queue (module docs)")
+    parser.add_argument("--requests", type=int, default=24,
+                        help="requests per serving cell (with --serving)")
+    parser.add_argument("--load", type=float, default=1.5,
+                        help="arrival rate as a multiple of queue "
+                             "capacity (with --serving)")
     args = parser.parse_args(argv)
 
     try:
@@ -320,19 +526,29 @@ def main(argv=None) -> int:
 
     from factormodeling_tpu import obs
 
-    rep = obs.RunReport("chaos")
+    rep = obs.RunReport("chaos-serving" if args.serving else "chaos")
     faults = None if args.faults == "all" else args.faults.split(",")
     policies = None if args.policies == "all" else args.policies.split(",")
     from factormodeling_tpu.resil import SnapshotCorrupt
 
     try:
-        verdict = run_chaos(
-            shape=shape, window=args.window, method=args.method,
-            faults=faults, policies=policies, rate=args.rate,
-            day_rate=args.day_rate, seed=args.seed, tol=args.tol,
-            report=rep, checkpoint_path=args.checkpoint,
-            checkpoint_every=args.checkpoint_every,
-            progress=lambda msg: print(msg, file=sys.stderr))
+        if args.serving:
+            verdict = run_serving_chaos(
+                shape=shape, window=args.window, method=args.method,
+                faults=faults, policies=policies,
+                n_requests=args.requests, load_factor=args.load,
+                seed=args.seed, tol=args.tol, report=rep,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                progress=lambda msg: print(msg, file=sys.stderr))
+        else:
+            verdict = run_chaos(
+                shape=shape, window=args.window, method=args.method,
+                faults=faults, policies=policies, rate=args.rate,
+                day_rate=args.day_rate, seed=args.seed, tol=args.tol,
+                report=rep, checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                progress=lambda msg: print(msg, file=sys.stderr))
     except ValueError as e:
         print(f"chaos: {e}", file=sys.stderr)
         return 2

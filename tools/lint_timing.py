@@ -236,7 +236,11 @@ def default_targets(repo_root=None) -> list[Path]:
     latency-claiming hot path (per-bucket walls feed the SLO sketches via
     instrument_jit), exactly where an ad-hoc unfenced throughput window
     would be tempting and wrong — the batched dispatch returns before a
-    single lane has computed."""
+    single lane has computed. The traffic layer (round 15) rides the
+    same globs: serve/queue.py's whole claim is that scheduling time is
+    VIRTUAL (an ambient perf_counter read there would re-couple verdict
+    logs to host jitter), and resil/retry.py owns the backoff sleeps a
+    careless wall-clock window would sit right next to."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))

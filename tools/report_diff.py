@@ -17,7 +17,9 @@ retrace, a new collective / comms-byte blowup in the placement ledger, a
 peak-device-memory jump, a sharding-lint flag (replicated/resharded
 operand), a latency-sketch p50/p99 beyond the wall ratio, a violated
 ``SLOSpec`` budget (gated even under ``--no-wall`` — the budget is the
-run's own declaration, not a machine comparison), or a seconds-valued
+run's own declaration, not a machine comparison), a serving queue that
+shed / missed / retried more requests than the baseline under the same
+traffic (``kind="serving"`` rows, round 15), or a seconds-valued
 bench row beyond the ratio AND the baseline's recorded best-of-N spread
 all exit 1 with a one-line attribution. Reports with mismatched
 ``kind="meta"`` schema versions REFUSE to gate; cross-backend pairs warn

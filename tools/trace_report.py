@@ -8,13 +8,15 @@ Spans aggregate by name (count / total / mean / max wall seconds, whether
 they fenced); counters, the solver section (scheme + Anderson-acceleration
 telemetry), numerics probes, compile telemetry, the placement ledger
 (comms / device memory / sharding lint), latency sketches (per-scope
-count + p50/p90/p99 + SLO verdict), device-time attribution, cost-analysis
-estimates, bench rows, and plain stage records print in their own
-sections. Pure stdlib — usable on any box that has the JSONL, no jax
+count + p50/p90/p99 + SLO verdict), the serving queue (verdict counts —
+served/shed/miss/failed must sum to submissions), device-time
+attribution, cost-analysis estimates, bench rows, and plain stage
+records print in their own sections. Pure stdlib — usable on any box that has the JSONL, no jax
 required.
 
 Exit codes: 0 = rendered (``--strict`` turns unsound spans, sharding-lint
-flags, SLO violations, and malformed latency/devtime rows into 1);
+flags, SLO violations, and malformed latency/devtime/serving rows — a
+serving row whose verdict counts do not sum to its submissions — into 1);
 2 = unusable input (missing/unreadable file, or no parseable rows at all
 — empty or fully corrupt). A truncated tail — a run killed mid-write — is
 skipped with a file:line warning and the surviving rows still render:
@@ -373,12 +375,54 @@ def _devtime_table(rows) -> str | None:
                           "host_frac", "note"), body))
 
 
+#: the verdict counts every kind="serving" row must carry, and whose sum
+#: must equal ``submitted`` — the queue's completeness contract, checked
+#: by ``--strict`` (malformed_serving)
+_SERVING_VERDICT_KEYS = ("served", "shed_count", "deadline_miss_count",
+                         "failed_count")
+_SERVING_INT_KEYS = _SERVING_VERDICT_KEYS + (
+    "submitted", "retry_count", "rung_downgrades", "dispatches")
+
+
+def _serving_table(rows) -> str | None:
+    sv = [r for r in rows if r.get("kind") == "serving"]
+    if not sv:
+        return None
+    # last row per name wins (a resumed queue re-emits its summary)
+    last: dict[str, dict] = {}
+    for r in sv:
+        last[r.get("name", "?")] = r
+
+    def g(r, key):
+        v = r.get(key)
+        return v if isinstance(v, (int, float)) else "-"
+
+    body = []
+    for name, r in sorted(last.items()):
+        extra = " ".join(
+            f"{k}={_num(r[k])}" for k in
+            ("stale_served", "cheap_fallbacks", "served_p99_s",
+             "virtual_makespan_s") if isinstance(r.get(k), (int, float))
+            and r.get(k))
+        body.append((name, g(r, "submitted"), g(r, "served"),
+                     g(r, "shed_count"), g(r, "deadline_miss_count"),
+                     g(r, "failed_count"), g(r, "retry_count"),
+                     g(r, "rung_downgrades"), g(r, "dispatches"),
+                     extra or "-"))
+    return ("== serving (request-queue verdict counts; "
+            "served+shed+miss+failed must equal submitted) ==\n"
+            + _fmt_table(("queue", "submitted", "served", "shed", "miss",
+                          "failed", "retries", "downgrades", "dispatches",
+                          "extra"), body))
+
+
 def _stage_table(rows) -> str | None:
     stages = [r for r in rows
               if r.get("kind") not in ("span", "counters", "cost", "bench",
                                        "numerics", "watchdog", "compile",
                                        "comms", "memory", "sharding",
-                                       "latency", "devtime", "meta")]
+                                       "latency", "devtime", "serving",
+                                       "meta")]
     if not stages:
         return None
     body = []
@@ -422,8 +466,8 @@ def render(rows) -> str:
             ("schema_version", "jax_version", "backend", "device_kind",
              "device_count", "mesh_shape") if meta.get(k) is not None))
     sections = [head]
-    for maker in (_span_table, _latency_table, _counter_table,
-                  _solver_table, _numerics_table,
+    for maker in (_span_table, _latency_table, _serving_table,
+                  _counter_table, _solver_table, _numerics_table,
                   _watchdog_table, _compile_table, _comms_table,
                   _memory_table, _sharding_table, _devtime_table,
                   _cost_table, _bench_table, _stage_table):
@@ -465,14 +509,33 @@ def slo_violations(rows) -> list[str]:
 
 
 def malformed_rows(rows) -> list[str]:
-    """Descriptions of latency/devtime rows missing their contract
-    fields — strict validation of the PR 9 row kinds. A latency row must
-    carry a count and (when non-empty) finite p50/p99; a devtime row must
-    carry device seconds OR an honest skip/error reason."""
+    """Descriptions of latency/devtime/serving rows missing their
+    contract fields — strict validation of the PR 9/15 row kinds. A
+    latency row must carry a count and (when non-empty) finite p50/p99; a
+    devtime row must carry device seconds OR an honest skip/error reason;
+    a serving row must carry non-negative integer verdict counts that SUM
+    to its submissions — the queue's completeness contract, judged from
+    the artifact alone."""
     bad = []
     for r in rows:
         kind = r.get("kind")
-        if kind == "latency":
+        if kind == "serving":
+            name = r.get("name", "?")
+            vals = {k: r.get(k) for k in _SERVING_INT_KEYS}
+            broken = [k for k, v in vals.items()
+                      if not isinstance(v, int) or isinstance(v, bool)
+                      or v < 0]
+            if broken:
+                bad.append(f"serving row {name!r}: missing/invalid "
+                           f"count(s) {broken}")
+                continue
+            total = sum(vals[k] for k in _SERVING_VERDICT_KEYS)
+            if total != vals["submitted"]:
+                bad.append(
+                    f"serving row {name!r}: verdict counts sum {total} "
+                    f"!= submitted {vals['submitted']} — a request was "
+                    f"silently dropped or double-counted")
+        elif kind == "latency":
             n = r.get("count")
             if not isinstance(n, int) or n < 0:
                 bad.append(f"latency row {r.get('name', '?')!r}: missing/"
@@ -502,9 +565,9 @@ def main(argv=None) -> int:
                              "(fenced NO: neither a device fence nor a "
                              "declared host-synchronous window), any "
                              "sharding-lint row is flagged, any latency "
-                             "SLO is violated, or any latency/devtime "
-                             "row is malformed — makes the renderer "
-                             "CI-able")
+                             "SLO is violated, or any latency/devtime/"
+                             "serving row is malformed — makes the "
+                             "renderer CI-able")
     args = parser.parse_args(argv)
     try:
         rows = load_rows(args.jsonl)
@@ -538,8 +601,9 @@ def main(argv=None) -> int:
             rc = 1
         malformed = malformed_rows(rows)
         if malformed:
-            print(f"strict: {len(malformed)} malformed latency/devtime "
-                  f"row(s): " + "; ".join(malformed), file=sys.stderr)
+            print(f"strict: {len(malformed)} malformed latency/devtime/"
+                  f"serving row(s): " + "; ".join(malformed),
+                  file=sys.stderr)
             rc = 1
         return rc
     return 0
