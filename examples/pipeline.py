@@ -228,6 +228,41 @@ def _serving_demo(report, say) -> None:
         f"cheap-fallback, {c['retry_count']} retries)")
 
 
+def _scenario_demo(report, say) -> None:
+    """A small scenario-engine sweep (factormodeling_tpu.scenarios,
+    round 16): bootstrap-resampled markets vmapped over a path axis with
+    the tenant config held fixed, risk folded through mergeable sketches
+    into ``kind="scenario"`` VaR/ES rows on the report. Imported LAZILY —
+    the unreported pipeline path never loads the scenarios package (its
+    structural-elision contract)."""
+    import numpy as np
+
+    from factormodeling_tpu import scenarios
+    from factormodeling_tpu.serve import TenantConfig
+
+    f, d, n, paths = 5, 100, 24, 12
+    suffixes = ("_eq", "_flx", "_long", "_short")
+    names = tuple(f"fam{i % 2}_f{i}{suffixes[i % 4]}" for i in range(f))
+    rng = np.random.default_rng(11)
+    res = scenarios.run_scenarios(
+        names=names,
+        template=TenantConfig(top_k=2, icir_threshold=-1.0,
+                              method="equal", window=10, max_weight=0.4,
+                              pct=0.25),
+        spec=scenarios.BootstrapSpec.make(seed=3, block_len=15),
+        factors=rng.normal(size=(f, d, n)).astype(np.float32),
+        returns=rng.normal(scale=0.02, size=(d, n)).astype(np.float32),
+        factor_ret=rng.normal(scale=0.01, size=(d, f)).astype(np.float32),
+        cap_flag=rng.integers(1, 4, size=(d, n)).astype(np.float32),
+        investability=np.ones((d, n), np.float32),
+        n_paths=paths, chunk=paths, report=report,
+        tag="pipeline/scenarios")
+    pnl = next(r for r in res.rows if r["metric"] == "pnl_total")
+    say(f"  {paths} bootstrap paths -> VaR{pnl['levels']} = {pnl['var']} "
+        f"ES = {pnl['es']} (pnl p50 {pnl['p50']}, "
+        f"nonfinite paths {pnl['nonfinite_paths']})")
+
+
 def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
                  window: int = 20, decay: int = 10, pct: float = 0.2,
                  max_weight: float = 0.5, qp_iters: int = 500,
@@ -430,6 +465,14 @@ def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
             # one compile per bucket, retrace-free steady state
             say("=== Many-tenant serving (signature buckets) ===")
             _serving_demo(report, say)
+
+            # ---- 10. scenario risk leg (reported runs only): the
+            # round-16 engine — a vmapped sweep of stressed markets with
+            # distributional VaR/ES rows (kind="scenario") landing in
+            # the report, where trace_report renders them and
+            # report_diff gates worsening
+            say("=== Scenario risk (vmapped stress markets) ===")
+            _scenario_demo(report, say)
     if report_path is not None:
         # process-wide compile totals + per-entry-point retrace verdicts —
         # the compat kernels' compile rows land during the run; this row

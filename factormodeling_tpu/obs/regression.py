@@ -68,11 +68,24 @@ flags):
   ``max(baseline value, baseline spread max)`` when the baseline carries
   a ``spread`` (best-of-N min/max), so a documented container-speed
   swing absorbs into the gate instead of crying wolf. RATE-valued rows
-  (unit ending in ``/s`` — the serving layer's
-  ``tenant_sweep_configs_per_sec`` throughput) gate in the OPPOSITE
-  direction under the same conventions: a drop below ``baseline /
-  wall_ratio`` is a regression, judged against ``min(baseline value,
-  baseline spread min)`` so the recorded run-to-run swing absorbs first.
+  gate in the OPPOSITE direction under the same conventions: ANY unit
+  ending in ``/s`` matches — the serving layer's ``configs/s``
+  throughput and the scenario engine's ``paths/s`` gate through this one
+  clause, no per-unit copies of the logic (unit-tested) — a drop below
+  ``baseline / wall_ratio`` is a regression, judged against
+  ``min(baseline value, baseline spread min)`` so the recorded
+  run-to-run swing absorbs first.
+- **scenario** (risk rows, round 16) — every baseline ``kind="scenario"``
+  row must still exist; its VaR/ES vectors (oriented bigger-is-worse for
+  every metric — loss magnitudes for PnL, raw upper tails for drawdown/
+  turnover) gate on WORSENING beyond ``wall_ratio`` x ``max(baseline,
+  baseline spread max)`` per level (the bench-row ratio+spread
+  convention; scenario sweeps are seeded-deterministic, so the gate
+  stays armed even under ``--no-wall`` — a risk worsening is never
+  machine speed). Non-finite VaR/ES in the new report and
+  ``nonfinite_paths`` growth are regressions outright (a path whose risk
+  scalar isn't a number is a broken scenario, not a tail event);
+  improvements and brand-new scenario rows are notes.
 
 Deliberately **pure stdlib** with no package-relative imports:
 ``tools/report_diff.py`` loads this file standalone (importlib by path) so
@@ -91,8 +104,8 @@ from pathlib import Path
 __all__ = ["DiffResult", "Finding", "GATE_UP", "bench_rows", "comms_rows",
            "counter_scalars", "devtime_rows", "diff_reports",
            "latency_rows", "load_jsonl", "memory_rows", "meta_row",
-           "numerics_baseline", "serving_rows", "sharding_rows",
-           "span_totals"]
+           "numerics_baseline", "scenario_rows", "serving_rows",
+           "sharding_rows", "span_totals"]
 
 #: counter keys whose INCREASE is a regression (everything else drifts
 #: informationally). Nested mean/max counters gate on their "mean" leaf.
@@ -273,6 +286,14 @@ def serving_rows(rows) -> dict:
             if r.get("kind") == "serving"}
 
 
+def scenario_rows(rows) -> dict:
+    """name -> last scenario risk row (kind="scenario"; one row per
+    (sweep tag, metric), the round-16 VaR/ES artifacts). Cell verdict
+    rows (kind="scenario_cell") are not risk rows and are excluded."""
+    return {r.get("name", ""): r for r in rows
+            if r.get("kind") == "scenario"}
+
+
 def bench_rows(rows) -> dict:
     """name -> last bench row (kind="bench", keyed by metric name)."""
     return {r.get("metric", r.get("name", "")): r for r in rows
@@ -289,7 +310,8 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
                  comms_ratio: float = 1.5,
                  comms_min_bytes: float = 1024.0,
                  mem_ratio: float = 1.5,
-                 mem_min_bytes: float = 1 << 20) -> DiffResult:
+                 mem_min_bytes: float = 1 << 20,
+                 risk_floor: float = 0.05) -> DiffResult:
     """Compare a fresh report against a known-good baseline (see module
     docs for the checks). Returns a :class:`DiffResult`; ``not result.ok``
     means gate-failing regressions were found."""
@@ -627,6 +649,80 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
         findings.append(Finding(
             "serving", name, "serving row absent from baseline (new "
             "traffic leg) — re-baseline to gate it"))
+
+    # ---- scenario risk rows: VaR/ES worsening gates at ratio+spread,
+    # non-finite risk and nonfinite-path growth gate outright. Scenario
+    # sweeps are seeded-deterministic, so — unlike walls — this gate
+    # stays armed under --no-wall and cross-backend: a risk worsening is
+    # never machine speed.
+    def _num(v):
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def _fin(v):
+        return _num(v) and v == v and abs(v) != float("inf")
+
+    base_sc, new_sc = scenario_rows(base_rows), scenario_rows(new_rows)
+    for name, base_row in sorted(base_sc.items()):
+        new_row = new_sc.get(name)
+        if new_row is None:
+            findings.append(Finding(
+                "scenario", name, "scenario risk row present in baseline, "
+                "missing in new report", regression=True))
+            continue
+        b_nf, n_nf = (base_row.get("nonfinite_paths", 0),
+                      new_row.get("nonfinite_paths", 0))
+        if _num(b_nf) and _num(n_nf) and n_nf > b_nf:
+            findings.append(Finding(
+                "scenario", f"{name}/nonfinite_paths",
+                f"{b_nf:g} -> {n_nf:g} paths produced a non-finite risk "
+                f"scalar — a broken scenario, not a tail event",
+                regression=True))
+        levels = base_row.get("levels")
+        if levels != new_row.get("levels"):
+            findings.append(Finding(
+                "scenario", name,
+                f"VaR/ES levels changed {levels} -> "
+                f"{new_row.get('levels')} — re-baseline to gate them"))
+            continue
+        spread = base_row.get("spread") or {}
+        for key in ("var", "es"):
+            bs, ns = base_row.get(key) or [], new_row.get(key) or []
+            smax = spread.get(key) or []
+            for i, level in enumerate(levels or []):
+                b = bs[i] if i < len(bs) else None
+                nv = ns[i] if i < len(ns) else None
+                label = f"{name}/{key}@{level:g}"
+                if not _fin(nv):
+                    findings.append(Finding(
+                        "scenario", label,
+                        f"non-finite {key.upper()} {nv!r} in the new "
+                        f"report", regression=True))
+                    continue
+                if not _fin(b):
+                    continue  # baseline itself ungateable
+                s = smax[i] if i < len(smax) and _fin(smax[i]) else b
+                eff = max(b, s)
+                # ratio for well-sized risks, absolute floor for tiny or
+                # negative ones (a ratio on a near-zero or negative VaR
+                # gates noise / inverts direction)
+                threshold = max(eff * wall_ratio, eff + risk_floor)
+                if nv > threshold:
+                    findings.append(Finding(
+                        "scenario", label,
+                        f"{key.upper()} worsened {b:.6g} -> {nv:.6g} "
+                        f"(beyond {wall_ratio:g}x / +{risk_floor:g} of "
+                        f"the baseline incl. recorded spread)",
+                        regression=True))
+                elif nv > max(b * wall_ratio, b + risk_floor):
+                    findings.append(Finding(
+                        "scenario", label,
+                        f"{key.upper()} worsened {b:.6g} -> {nv:.6g} — "
+                        f"within the baseline's recorded spread, judged "
+                        f"run-to-run swing"))
+    for name in sorted(set(new_sc) - set(base_sc)):
+        findings.append(Finding(
+            "scenario", name, "scenario risk row absent from baseline "
+            "(new sweep) — re-baseline to gate it"))
 
     # ---- bench rows: seconds-valued rows gate at wall_ratio against the
     # spread-aware baseline; presence never gates (configs are selected

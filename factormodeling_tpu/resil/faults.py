@@ -59,6 +59,8 @@ import jax
 import jax.numpy as jnp
 from jax import random
 
+from factormodeling_tpu import rng as rng_lanes
+
 __all__ = ["DISPATCH_FAULT_CLASSES", "FAULT_CLASSES", "INJECT_STAGES",
            "DispatchFault", "DispatchFaultPlan", "FaultSpec", "inject",
            "inject_universe", "staleness_canary"]
@@ -73,10 +75,13 @@ INJECT_STAGES = ("ops/factors_raw", "selection/rolling", "composite/blend")
 FAULT_CLASSES = ("nan_burst", "inf_spike", "outlier", "stale_repeat",
                  "drop_day", "universe_collapse")
 
-# disjoint fold_in lanes per fault class so changing one class's rate
-# never reshuffles another's mask (the chaos matrix diffs cells against
-# the clean baseline cell-by-cell)
-_LANE = {name: 7919 + 31 * i for i, name in enumerate(FAULT_CLASSES)}
+# disjoint lanes per fault class so changing one class's rate never
+# reshuffles another's mask (the chaos matrix diffs cells against the
+# clean baseline cell-by-cell). The lane ids live in the central registry
+# (factormodeling_tpu.rng, round 16) under "fault/<class>" names, with
+# the historic 7919 + 31*i values frozen there so every seeded mask is
+# bit-compatible across the refactor (pinned in tests/test_rng.py).
+_LANE = {name: rng_lanes.lane_id(f"fault/{name}") for name in FAULT_CLASSES}
 
 
 @jax.tree_util.register_dataclass
@@ -196,6 +201,11 @@ class DispatchFaultPlan:
     error_rate: float = 0.0
     poison_rate: float = 0.0
 
+    #: host-side RNG lane of the per-attempt draw (the central registry,
+    #: factormodeling_tpu.rng) — the arrival harnesses draw under their
+    #: own lanes, so a plan and a trace at the same seed stay independent
+    _LANE = "serve/dispatch_fault"
+
     def __post_init__(self):
         for name in ("error_rate", "poison_rate"):
             v = float(getattr(self, name))
@@ -208,10 +218,8 @@ class DispatchFaultPlan:
 
     def roll(self, attempt: int) -> "str | None":
         """The fault class injected at this attempt index, or None."""
-        import numpy as np
-
-        u = float(np.random.default_rng(
-            (int(self.seed), int(attempt))).uniform())
+        u = float(rng_lanes.lane_rng(self._LANE, self.seed,
+                                     int(attempt)).uniform())
         if u < self.error_rate:
             return "dispatch_error"
         if u < self.error_rate + self.poison_rate:
@@ -220,8 +228,9 @@ class DispatchFaultPlan:
 
 
 def _key(spec: FaultSpec, stage_idx: int, kind: str):
-    return random.fold_in(random.fold_in(random.PRNGKey(spec.seed),
-                                         stage_idx), _LANE[kind])
+    # registry derivation == the historic fold order (seed, stage, lane):
+    # bit-compatible with every pre-registry seeded mask
+    return rng_lanes.lane_key(f"fault/{kind}", spec.seed, stage_idx)
 
 
 def _day_mask(shape, date_axis: int, mask_d):

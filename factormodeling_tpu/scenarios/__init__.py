@@ -1,0 +1,50 @@
+"""Scenario engine: vmapped stress markets, counterfactual paths, and
+distributional risk analytics (ROADMAP item 3, architecture.md §22).
+
+The axis inversion of PR 9: the tenant config is held fixed and the
+MARKET batches over a path axis —
+
+- :mod:`~factormodeling_tpu.scenarios.spec` — the three scenario
+  families as seeded, fully-traced pytree specs (``FaultSpec`` style):
+  :class:`BootstrapSpec` (circular block-bootstrap resampled markets),
+  :class:`RegimeSpec` (counterfactual vol/drift/correlation regime
+  breaks), :class:`AdversarialSpec` (PR 7's fault classes re-targeted at
+  the market inputs under sustained scheduled windows).
+- :mod:`~factormodeling_tpu.scenarios.engine` —
+  :func:`make_scenario_step` / :func:`run_scenarios`: paths run through
+  the serving layer's per-tenant program vmapped over the path axis,
+  with the sort-heavy per-date stats HOISTED out of the vmap (no sort
+  touches a ``[P, F, D, N]`` operand — the §20 discipline, HLO-pinned),
+  chunked with exact checkpoint/resume.
+- :mod:`~factormodeling_tpu.scenarios.risk` — distributional PnL,
+  VaR/ES at configurable levels, drawdown and turnover quantiles, all
+  folded through the PR 8 mergeable quantile sketch and emitted as
+  ``kind="scenario"`` RunReport rows.
+
+Structurally inert by default: nothing outside this package imports it
+at module level (``tools/chaos.py --scenarios``, ``bench.py``, and the
+examples import lazily), and the default research step reproduces its
+bits with this package made unimportable — the PR 7/10 elision
+discipline, subprocess-pinned in tests/test_scenarios.py.
+"""
+
+from factormodeling_tpu.scenarios.engine import (  # noqa: F401
+    ScenarioResult,
+    make_scenario_runner,
+    make_scenario_step,
+    run_scenarios,
+)
+from factormodeling_tpu.scenarios.risk import (  # noqa: F401
+    DEFAULT_LEVELS,
+    RISK_METRICS,
+    RiskAccumulator,
+    SignedSketch,
+)
+from factormodeling_tpu.scenarios.spec import (  # noqa: F401
+    SCENARIO_FAMILIES,
+    AdversarialSpec,
+    BootstrapSpec,
+    RegimeSpec,
+    family_of,
+    path_key,
+)

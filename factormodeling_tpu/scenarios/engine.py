@@ -1,0 +1,488 @@
+"""The vmapped scenario engine: one tenant config, a batch of markets.
+
+PR 9 batches 256 tenant CONFIGS over one market per dispatch
+(``serve/batched.py``); this module inverts the axes — the config is held
+fixed and the MARKET batches over a path axis ``P``. Each path is a
+seeded, traced transform of the base market (resampled / regime-shifted /
+adversarial, :mod:`factormodeling_tpu.scenarios.spec`) run through the
+serving layer's exact per-tenant program
+(:func:`factormodeling_tpu.serve.tenant_step_parts`), so strategy
+robustness (VaR/ES, drawdown tails) and system robustness (finite
+outputs, production invariants under a ``DegradePolicy``) are measured by
+the same engine.
+
+**The path-axis hoist rule** (the §20 discipline, restated for markets):
+no sort may touch a ``[P, F, D, N]`` operand — HLO-pinned like PR 9's
+``[C, F, D, N]`` pin. The sort-heavy stack traversal is the per-date
+rank-IC computation (``daily_factor_stats``: one ``lax.sort`` of the
+whole ``[F, D, N]`` stack), and it is per-DATE-local — which is exactly
+what makes the hoist possible even though markets vary per path:
+
+- **bootstrap** resamples the per-date JOINT observation, so the per-path
+  stats are a date GATHER of the hoisted ``[F, D]`` stats (gathers are
+  fine; only sorts are pinned), and the rolling windows re-aggregate the
+  gathered sequence per path — cheap ``[P, F, D]`` scans, no sort.
+- **regime** transforms are per-date positive affine maps of the
+  cross-section, under which IC and rank-IC are EXACTLY invariant
+  (Pearson and Spearman both) — the hoisted stats are exact, and the
+  counterfactual hits the backtest, where it belongs. (Selectors that
+  consume raw factor RETURNS — momentum — see the base factor-return
+  panel under this family; a regime model for factor returns is a
+  different spec, documented in architecture §22.)
+- **adversarial** day classes (stale/drop) act on the stats by
+  gather/NaN-mask (a dropped date leaves the rolling windows — the
+  NaN-aware reducers skip it, PR 7's quarantine semantics); cell classes
+  corrupt the ``[D, N]`` market surface the blend and backtest consume
+  (the per-path factor VIEW and return panel — elementwise, sort-free).
+  Corrupting the raw exposures BEFORE the rank stack would force a
+  per-path ``[P, F, D, N]`` sort — precisely what the pin forbids; the
+  single-market chaos matrix (PR 7) covers that axis at full fidelity.
+
+The weighted composite's pooled percentiles legitimately batch (they
+depend on the day's corrupted/resampled columns — per-path work, sorted
+at ``[P, D, K*N]``), the PR 9 note verbatim.
+
+**Chunking and resume**: paths dispatch in host-loop chunks (optionally
+``lax.map``-chunked inside one dispatch for memory, ``map_chunk``); the
+per-chunk path metrics fold into :class:`~factormodeling_tpu.scenarios.
+risk.RiskAccumulator` sketches, which merge EXACTLY — so after every
+chunk the accumulator state snapshots through ``resil.checkpoint``, and a
+killed sweep resumes with rows bit-equal to straight-through (the PR 7
+pattern, pinned in tests/test_scenarios.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from factormodeling_tpu.metrics import daily_factor_stats, rolling_metrics
+from factormodeling_tpu.obs.compile_log import instrument_jit
+from factormodeling_tpu.obs.trace import stage as obs_stage
+from factormodeling_tpu.ops._window import shift
+from factormodeling_tpu.scenarios.risk import (
+    DEFAULT_LEVELS,
+    RiskAccumulator,
+)
+from factormodeling_tpu.scenarios.spec import family_of, path_key
+from factormodeling_tpu.selection import (
+    finish_selection_context,
+    selection_metric_needs,
+)
+from factormodeling_tpu.serve import tenant_step_parts
+
+__all__ = ["ScenarioResult", "make_scenario_runner", "make_scenario_step",
+           "run_scenarios"]
+
+#: test hook: return the partial (row-less) result right after
+#: checkpointing this many chunks — the mid-sweep-kill seam of the resume
+#: differential (tests/test_scenarios.py); mirrors the chaos CLI's
+#: ``_FMT_CHAOS_DIE_AFTER_CELL`` pattern without needing a subprocess.
+_STOP_ENV = "_FMT_SCEN_STOP_AFTER_CHUNK"
+
+
+def _path_metrics(out):
+    """Per-path risk scalars off one ResearchOutput (device-side; the
+    names are :data:`~factormodeling_tpu.scenarios.risk.RISK_METRICS`)."""
+    lr = out.sim.result.log_return                       # [D]
+    lr0 = jnp.where(jnp.isnan(lr), 0.0, lr)
+    cum = jnp.cumsum(lr0)
+    running_peak = lax.cummax(jnp.maximum(cum, 0.0))     # flat start = 0
+    return {
+        "pnl_total": out.summary.total_log_return,
+        "max_drawdown": jnp.max(running_peak - cum),
+        "mean_turnover": out.summary.mean_turnover,
+        "worst_day_loss": -jnp.min(lr0),
+    }
+
+
+def make_scenario_step(*, names, template, family: str,
+                       return_books: bool = False, map_chunk=None):
+    """Build the jittable path-vmapped step for one scenario family.
+
+    Returns ``step(tenant, spec, policy, path_ix, factors, returns,
+    factor_ret, cap_flag, investability, universe=None)`` where
+    ``tenant`` is a normalized
+    :class:`~factormodeling_tpu.serve.TenantConfig`, ``spec`` the
+    family's traced scenario pytree, ``policy`` an optional traced
+    :class:`~factormodeling_tpu.resil.DegradePolicy` (None traces no
+    degradation subgraph — argument-presence elision), and ``path_ix``
+    an ``int32[P]`` of path indices. Output leaves carry the leading
+    path axis: per-path metric dict (+ degrade tallies with a policy,
+    + the full stacked ResearchOutput when ``return_books``).
+
+    ``map_chunk``: when set, the ``P`` lanes run as ``lax.map`` over
+    sequential ``map_chunk``-wide vmapped sub-batches (plus a vmapped
+    ragged tail, concatenated) — bounding the resident ``[p, F, D, N]``
+    working set without more dispatches, for any ``P``.
+    """
+    names = tuple(names)
+    window = template.window
+    select_static = dict(template.select_static)
+    if template.select_method == "icir_top":
+        select_static["use_rank_icir"] = template.use_rank_icir
+    needs = selection_metric_needs(template.select_method, select_static)
+    _, tenant_body = tenant_step_parts(names, template)
+
+    def step(tenant, spec, policy, path_ix, factors, returns, factor_ret,
+             cap_flag, investability, universe=None):
+        d = returns.shape[0]
+        if window >= d:
+            raise ValueError(f"window {window} >= {d} dates: the "
+                             f"processed range is empty, no path to run")
+        with obs_stage("scenarios/daily_stats"):
+            # THE HOIST: the sort-heavy per-date stats are built once per
+            # dispatch from the base market; every path consumes them by
+            # gather/mask (module docs — this is what keeps sorts off
+            # [P, F, D, N] operands)
+            daily = {}
+            if needs:
+                raw = daily_factor_stats(factors, returns, shift_periods=2,
+                                         universe=universe, stats=needs)
+                daily = {k: raw[k] for k in needs}          # [F, D] each
+
+        def one(p):
+            key = path_key(spec, p)
+            stat_nan = None          # [D] dates masked out of the windows
+            if family == "bootstrap":
+                idx = spec.day_index(key, d)
+                f_view = jnp.take(factors, idx, axis=1)
+                r_view = jnp.take(returns, idx, axis=0)
+                fr_view = jnp.take(factor_ret, idx, axis=0)
+                cap_view = jnp.take(cap_flag, idx, axis=0)
+                inv_view = jnp.take(investability, idx, axis=0)
+                uni_view = (None if universe is None
+                            else jnp.take(universe, idx, axis=0))
+            elif family == "regime":
+                # factors/universe stay the CLOSED-OVER base operands, so
+                # vmap leaves them unbatched and the whole selection+blend
+                # prefix is shared across paths (per-date affine maps
+                # leave IC/rank-IC exactly invariant — module docs)
+                idx = None
+                f_view, fr_view = factors, factor_ret
+                cap_view, inv_view, uni_view = (cap_flag, investability,
+                                                universe)
+                r_view = spec.transform_returns(key, returns)
+            elif family == "adversarial":
+                in_win, stale, drop, collapse = spec.schedule(key, d)
+                days = jnp.arange(d)
+                idx = jnp.where(stale, jnp.maximum(days - 1, 0), days)
+                masks = spec.cell_masks(key, returns.shape, in_win)
+                f_view = spec.apply_cells(jnp.take(factors, idx, axis=1),
+                                          masks)
+                # the RETURN panel takes only the NaN mask: a corrupt
+                # return observation is a MISSING observation (the
+                # NaN-aware pnl path skips it), while an Inf/outlier
+                # realized return would make every book's pnl non-finite
+                # regardless of policy — that is a market-data
+                # impossibility, not a survivable scenario (degradation
+                # policies guard books, not the laws of arithmetic;
+                # architecture §22). Exposure corruption gets the full
+                # PR 7 cell treatment above.
+                r_view = jnp.where(masks[0], jnp.nan,
+                                   jnp.take(returns, idx, axis=0))
+                drop_col = drop[:, None]
+                f_view = jnp.where(drop[None, :, None], jnp.nan, f_view)
+                r_view = jnp.where(drop_col, jnp.nan, r_view)
+                fr_view = jnp.where(drop_col, jnp.nan,
+                                    jnp.take(factor_ret, idx, axis=0))
+                cap_view = jnp.take(cap_flag, idx, axis=0)
+                inv_view = jnp.take(investability, idx, axis=0)
+                uni = (jnp.ones(returns.shape, bool) if universe is None
+                       else universe)
+                uni_view = jnp.take(uni, idx, axis=0)
+                rank = jnp.cumsum(uni_view.astype(jnp.int32), axis=1)
+                collapsed = uni_view & (rank <= spec.collapse_keep)
+                uni_view = jnp.where(collapse[:, None], collapsed, uni_view)
+                stat_nan = drop
+            else:  # pragma: no cover - guarded by run_scenarios
+                raise ValueError(f"unknown scenario family {family!r}")
+
+            daily_p = {k: (v if idx is None else jnp.take(v, idx, axis=1))
+                       for k, v in daily.items()}
+            if stat_nan is not None:
+                daily_p = {k: jnp.where(stat_nan[None, :], jnp.nan, v)
+                           for k, v in daily_p.items()}
+            fr_ctx = fr_view
+            tallies = None
+            if policy is not None:
+                from factormodeling_tpu.resil import policy as resil_policy
+
+                # NaN-day quarantine at the stats level (PR 7 semantics:
+                # protect the windowed statistics, keep the day's own
+                # cross-section trading)
+                qday = resil_policy.quarantine_days(f_view, uni_view,
+                                                    policy)
+                daily_p = {k: jnp.where(qday[None, :], jnp.nan, v)
+                           for k, v in daily_p.items()}
+                fr_ctx = jnp.where(qday[:, None], jnp.nan, fr_view)
+                tallies = {"quarantined_days": qday.sum().astype(jnp.int32)}
+            if daily_p:
+                rm = rolling_metrics(daily_p, max(window - 1, 1))
+                metrics_win = {k: shift(v, 1, axis=-1)
+                               for k, v in rm.items()}
+            else:
+                metrics_win = {}
+            ctx = finish_selection_context(metrics_win, fr_ctx, window)
+            out = tenant_body(tenant, ctx, f_view, r_view, cap_view,
+                              inv_view, uni_view, policy=policy)
+            if policy is not None:
+                hold = out.sim.degrade
+                zero = jnp.zeros((), jnp.int32)
+                tallies.update(
+                    held_days=(zero if hold is None else hold.held_days),
+                    carry_days=(zero if hold is None else hold.carry_days))
+            mets = _path_metrics(out)
+            res = (mets,) + ((tallies,) if policy is not None else ()) \
+                + ((out,) if return_books else ())
+            return res[0] if len(res) == 1 else res
+
+        with obs_stage("scenarios/paths"):
+            p = path_ix.shape[0]
+            if map_chunk is None or p <= map_chunk:
+                return jax.vmap(one)(path_ix)
+            # lax.map over the dividing head + a vmapped ragged tail
+            # (concatenated), so ANY width works — run_scenarios' host
+            # chunking routinely produces a tail that neither fits in
+            # nor divides by map_chunk, and raising there mid-sweep
+            # would strand every resume on the same chunk. Residency
+            # stays bounded by max(map_chunk, tail) < 2 * map_chunk.
+            head = (p // map_chunk) * map_chunk
+            grid = path_ix[:head].reshape(head // map_chunk, map_chunk)
+            mapped = lax.map(jax.vmap(one), grid)
+            out = jax.tree_util.tree_map(
+                lambda a: a.reshape((head,) + a.shape[2:]), mapped)
+            if head == p:
+                return out
+            tail = jax.vmap(one)(path_ix[head:])
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate([a, b]), out, tail)
+
+    return step
+
+
+def make_scenario_runner(*, names, template, family: str,
+                         return_books: bool = False, map_chunk=None):
+    """The jitted, compile-instrumented scenario step for one family —
+    build ONCE and thread the same runner through many
+    :func:`run_scenarios` calls (``runner=``) so a grid of specs/policies
+    over one family compiles exactly one executable (spec, policy, and
+    path indices are all traced values; only a policy's PRESENCE changes
+    the trace). Without an explicit runner every ``run_scenarios`` call
+    builds a fresh jit — correct, but a fresh compile per call."""
+    step = make_scenario_step(names=names, template=template, family=family,
+                              return_books=return_books,
+                              map_chunk=map_chunk)
+    # expected_signatures stays None: a runner legitimately compiles one
+    # executable per (path-batch width, policy presence) — a ragged tail
+    # chunk and the single-path bench loop are distinct signatures, not
+    # retraces; the detector still flags same-signature recompiles
+    runner = instrument_jit(jax.jit(step), f"scenarios/step/{family}")
+    # build identity, so run_scenarios(runner=...) can fail FAST on a
+    # runner built for a different family/output shape instead of an
+    # AttributeError deep inside the trace
+    runner.scenario_build = {"family": family,
+                             "return_books": bool(return_books),
+                             "map_chunk": map_chunk}
+    return runner
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    """One scenario sweep's artifact (see :func:`run_scenarios`)."""
+
+    family: str
+    n_paths: int
+    rows: list                      # kind="scenario" report rows
+    accumulator: RiskAccumulator    # mergeable per-metric sketches
+    nonfinite: dict                 # metric -> paths whose scalar wasn't
+    #: paths with AT LEAST one non-finite metric — the per-PATH failure
+    #: count (summing `nonfinite` values would count one broken path
+    #: once per metric)
+    nonfinite_path_count: int
+    degrade: dict                   # summed per-path policy tallies
+    books: object = None            # stacked ResearchOutput (return_books)
+    completed: bool = True          # False = stopped by the test seam
+
+    @property
+    def finite_ok(self) -> bool:
+        """True when every path produced a finite value for every risk
+        metric — the acceptance grid's first invariant."""
+        return not any(self.nonfinite.values())
+
+    def book(self, path: int):
+        """The path-th ResearchOutput slice (requires ``return_books``)."""
+        if self.books is None:
+            raise ValueError("run_scenarios(return_books=True) required")
+        return jax.tree_util.tree_map(lambda a: a[path], self.books)
+
+
+def run_scenarios(*, names, template, spec, policy=None, factors, returns,
+                  factor_ret, cap_flag, investability, universe=None,
+                  n_paths: int = 256, chunk: int = 64,
+                  levels=DEFAULT_LEVELS, return_books: bool = False,
+                  map_chunk=None, checkpoint_path=None,
+                  checkpoint_every: int = 1, report=None, tag=None,
+                  runner=None, progress=None) -> ScenarioResult:
+    """Run ``n_paths`` scenario paths of one family through the tenant
+    step, chunked, and fold the per-path risk scalars into mergeable
+    sketches (module docs). Returns a :class:`ScenarioResult`; with
+    ``report`` (an ``obs.RunReport``) the ``kind="scenario"`` rows are
+    recorded onto it.
+
+    ``checkpoint_path`` snapshots the accumulator + chunk cursor after
+    every ``checkpoint_every`` chunks (``resil.checkpoint``, guarded by a
+    content fingerprint of panels/spec/config): kill the sweep mid-run,
+    rerun the same call, and the final rows are BIT-EQUAL to a
+    straight-through run — the sketches merge exactly, so resume cannot
+    change the answer. Incompatible with ``return_books`` (books are not
+    snapshotted; a resumed sweep could not reconstruct the killed run's).
+    """
+    import numpy as np
+
+    from factormodeling_tpu import resil
+
+    family = family_of(spec)
+    if n_paths < 1:
+        raise ValueError(f"n_paths must be >= 1, got {n_paths}")
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    if return_books and checkpoint_path is not None:
+        raise ValueError("return_books=True cannot be checkpointed: books "
+                         "are not snapshotted, so a resumed sweep would "
+                         "silently lose the killed run's paths")
+    from factormodeling_tpu.composite import prefix_group_ids
+
+    names = tuple(names)
+    n_groups = len(prefix_group_ids(names)[1])
+    # dtype read without materializing the panel on host (jnp and np
+    # arrays both expose .dtype; a device array must not round-trip for
+    # one attribute)
+    dtype = np.dtype(getattr(returns, "dtype", None)
+                     or np.asarray(returns).dtype)
+    tenant = template.normalized(len(names), n_groups, dtype=dtype)
+    tag = tag or f"scenarios/{family}"
+
+    if runner is not None:
+        want = {"family": family, "return_books": bool(return_books),
+                "map_chunk": map_chunk}
+        got = getattr(runner, "scenario_build", None)
+        if got != want:
+            raise ValueError(
+                f"runner was built with {got}, this call needs {want} — "
+                f"build it via make_scenario_runner with matching "
+                f"family/return_books/map_chunk")
+        jitted = runner
+    else:
+        jitted = make_scenario_runner(
+            names=names, template=template, family=family,
+            return_books=return_books, map_chunk=map_chunk)
+    panels = (factors, returns, factor_ret, cap_flag, investability,
+              universe)
+
+    acc = RiskAccumulator(levels)
+    nonfinite: dict[str, int] = {}
+    nonfinite_path_count = 0
+    degrade: dict[str, int] = {}
+    n_chunks = -(-n_paths // chunk)
+    start_chunk = 0
+    ck = None
+    if checkpoint_path is not None:
+        ck_meta = {
+            "entry": "scenarios",
+            "config": [family, int(n_paths), int(chunk),
+                       [float(v) for v in levels], repr(tenant.static_key()),
+                       map_chunk if map_chunk is None else int(map_chunk)],
+            # content guard: resuming sketches computed from different
+            # panels/spec/policy/config silently corrupts the merged rows
+            "fingerprint": resil.fingerprint(
+                *(p for p in panels if p is not None),
+                *jax.tree_util.tree_leaves(spec),
+                *jax.tree_util.tree_leaves(policy),
+                *jax.tree_util.tree_leaves(tenant)),
+        }
+        ck = resil.Checkpointer(checkpoint_path, every=checkpoint_every)
+        got = ck.resume(expect_meta=ck_meta)
+        if got is not None:
+            state, _ = got
+            start_chunk = int(state["next_chunk"])
+            acc = RiskAccumulator.from_state(state["acc"])
+            nonfinite = {k: int(v) for k, v in state["nonfinite"].items()}
+            nonfinite_path_count = int(state["nonfinite_path_count"])
+            degrade = {k: int(v) for k, v in state["degrade"].items()}
+            if progress:
+                progress(f"scenarios: resumed {start_chunk}/{n_chunks} "
+                         f"chunks from {checkpoint_path}")
+
+    stop_after = os.environ.get(_STOP_ENV)
+    books_chunks = []
+    for ci in range(start_chunk, n_chunks):
+        lo, hi = ci * chunk, min((ci + 1) * chunk, n_paths)
+        path_ix = jnp.arange(lo, hi, dtype=jnp.int32)
+        res = jitted(tenant, spec, policy, path_ix, *panels)
+        if policy is not None and return_books:
+            mets, tallies, outs = res
+        elif policy is not None:
+            mets, tallies = res
+        elif return_books:
+            mets, outs = res
+        else:
+            mets = res
+        host = {k: np.asarray(v) for k, v in mets.items()}
+        # a broken path counts ONCE here, however many of its metrics
+        # went non-finite (the per-metric tallies feed the rows)
+        nonfinite_path_count += int((~np.logical_and.reduce(
+            [np.isfinite(v) for v in host.values()])).sum())
+        for k in sorted(host):
+            vals = host[k]
+            for v in vals:
+                if np.isfinite(v):
+                    acc.observe(k, float(v))
+                else:
+                    nonfinite[k] = nonfinite.get(k, 0) + 1
+        if policy is not None:
+            for k, v in tallies.items():
+                degrade[k] = degrade.get(k, 0) + int(np.asarray(v).sum())
+        if return_books:
+            books_chunks.append(outs)
+        if progress:
+            progress(f"{tag}: chunk {ci + 1}/{n_chunks} "
+                     f"({hi}/{n_paths} paths)")
+        if ck is not None:
+            ck.maybe_save(ci, {"next_chunk": ci + 1, "acc": acc.state(),
+                               "nonfinite": dict(nonfinite),
+                               "nonfinite_path_count": nonfinite_path_count,
+                               "degrade": dict(degrade)}, meta=ck_meta)
+            if stop_after is not None \
+                    and ci - start_chunk + 1 >= int(stop_after):
+                # the kill seam: checkpoint written, NO rows emitted —
+                # exactly the state a SIGKILLed sweep leaves behind
+                return ScenarioResult(
+                    family=family, n_paths=n_paths, rows=[],
+                    accumulator=acc, nonfinite=dict(nonfinite),
+                    nonfinite_path_count=nonfinite_path_count,
+                    degrade=dict(degrade), completed=False)
+
+    books = None
+    if return_books:
+        books = (books_chunks[0] if len(books_chunks) == 1 else
+                 jax.tree_util.tree_map(
+                     lambda *xs: jnp.concatenate(xs), *books_chunks))
+    rows = acc.rows(tag, family=family, n_paths=n_paths)
+    for row in rows:
+        row["nonfinite_paths"] = nonfinite.get(row["metric"], 0)
+        if degrade:
+            row["degrade"] = dict(degrade)
+    if report is not None:
+        for row in rows:
+            fields = {k: v for k, v in row.items()
+                      if k not in ("kind", "name")}
+            report.record(row["name"], kind="scenario", **fields)
+    return ScenarioResult(family=family, n_paths=n_paths, rows=rows,
+                          accumulator=acc, nonfinite=dict(nonfinite),
+                          nonfinite_path_count=nonfinite_path_count,
+                          degrade=dict(degrade), books=books)

@@ -1,0 +1,294 @@
+"""Scenario specs: seeded, fully-traced market-transform pytrees.
+
+Three scenario FAMILIES, each a registered frozen dataclass in the
+``resil.faults.FaultSpec`` style — every field a traced array leaf, so one
+compiled scenario step serves a whole grid of parameter settings, and the
+identity setting reproduces the base market bit-for-bit through the SAME
+executable:
+
+- :class:`BootstrapSpec` — **resampled markets**: circular block
+  bootstrap of the ``[D, N]`` return panel and every other per-date
+  market surface. Each path draws block-start indices (one traced
+  ``randint`` per block slot, NO host loop) and gathers dates by
+  ``idx[d] = (start[d // L] + d % L) mod D``. The resampled unit is the
+  per-date JOINT observation — shifted exposures, same-date returns, and
+  the per-date selection stats computed from them — the standard
+  block-bootstrap choice that keeps each date's cross-section (and its
+  IC) internally coherent while scrambling the time structure the
+  rolling windows and the backtest actually depend on.
+- :class:`RegimeSpec` — **counterfactual regimes**: a structural break at
+  a seeded per-path date, after which returns are vol-scaled, drift-
+  shifted, and cross-sectionally correlation-tightened
+  (``r' = (1-c) * r + c * crossmean(r)`` raises every pairwise
+  correlation toward 1). All three are per-date POSITIVE AFFINE maps of
+  the cross-section, so the per-date IC and rank-IC stats are exactly
+  invariant (Pearson and Spearman are affine-invariant) — the hoisted
+  selection stats stay exact, and the counterfactual hits where it
+  should: the P&L, drawdowns, and solver inputs of the backtest.
+- :class:`AdversarialSpec` — **adversarial markets**: PR 7's fault
+  classes re-targeted at the market inputs under a scenario SCHEDULE — a
+  seeded per-path sustained window (default 20 days), not i.i.d. rates.
+  Inside the window: per-date stale/drop/universe-collapse draws and
+  per-cell NaN/Inf/outlier corruption of the ``[D, N]`` market surface
+  (a corrupt symbol-date observation poisons every factor computed from
+  it, which is how real vendor-file corruption arrives). Day classes act
+  on the hoisted per-date stats too (a dropped date leaves the rolling
+  windows, a stale date re-serves the previous date's stats); cell
+  classes corrupt the factor view the blend and the return panel the
+  backtest consume. Run it with a ``DegradePolicy`` to validate
+  degradation under thousands of paths instead of 24 single-fault cells.
+
+Seeding rides the central lane registry (:mod:`factormodeling_tpu.rng`):
+each path's root key is ``lane_key("scenario/path", seed, path_ix)`` and
+every family sub-draw folds its own registered lane, so two families at
+the same seed never share a stream and adding a draw to one family never
+reshuffles another's paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import random
+
+from factormodeling_tpu import rng as rng_lanes
+
+__all__ = ["SCENARIO_FAMILIES", "AdversarialSpec", "BootstrapSpec",
+           "RegimeSpec", "family_of", "path_key"]
+
+
+def path_key(spec, path_ix):
+    """The per-path root ``jax.random`` key: seed x path index under the
+    registered ``scenario/path`` lane. Family sub-draws fold their own
+    lanes under it (:func:`_sub`)."""
+    return rng_lanes.lane_key("scenario/path", spec.seed, path_ix)
+
+
+def _sub(key, lane: str):
+    return random.fold_in(key, rng_lanes.lane_id(lane))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BootstrapSpec:
+    """Circular block-bootstrap resampling (family ``"bootstrap"``).
+
+    ``block_len`` is a traced value: the same executable sweeps block
+    lengths. A block length >= D degenerates to a single rotated copy of
+    the sample (one start draw), block length 1 to i.i.d. date
+    resampling.
+    """
+
+    seed: jnp.ndarray       # int32[] PRNG root
+    block_len: jnp.ndarray  # int32[] >= 1
+
+    @classmethod
+    def make(cls, *, seed: int = 0, block_len: int = 20) -> "BootstrapSpec":
+        if int(block_len) < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
+        return cls(seed=jnp.asarray(int(seed), jnp.int32),
+                   block_len=jnp.asarray(int(block_len), jnp.int32))
+
+    def day_index(self, key, d: int) -> jnp.ndarray:
+        """``int32[D]`` resampled day indices for one path (traceable:
+        one vectorized randint over the block slots, a gather, modular
+        arithmetic — no host loop)."""
+        length = jnp.maximum(self.block_len, 1)
+        days = jnp.arange(d)
+        block_id = days // length
+        offset = days - block_id * length
+        # one start per possible block slot (D is the static upper bound
+        # on the number of blocks; unused slots cost nothing after DCE-
+        # friendly gathers)
+        starts = random.randint(_sub(key, "scenario/bootstrap"), (d,), 0, d)
+        return (jnp.take(starts, block_id) + offset) % d
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RegimeSpec:
+    """Counterfactual regime break (family ``"regime"``).
+
+    Per path: a break date ``s ~ U{0..D-1}`` and an intensity
+    ``u ~ U[0, 1]`` are drawn; from the break on, returns become
+    ``(r * vol(u) + shift(u))`` tightened toward the cross-sectional mean
+    by ``c(u)``, where each knob interpolates from identity to its spec
+    value with ``u`` — so one spec yields a DISTRIBUTION of regime
+    severities across paths, with the spec values as the worst case.
+    ``vol_scale=1, mean_shift=0, corr_tighten=0`` (:meth:`off`) is the
+    bitwise identity on every path through the same executable.
+    """
+
+    seed: jnp.ndarray          # int32[]
+    vol_scale: jnp.ndarray     # float[] full-strength multiplier (>0)
+    mean_shift: jnp.ndarray    # float[] full-strength per-day drift shift
+    corr_tighten: jnp.ndarray  # float[] full-strength tightening in [0, 1)
+
+    @classmethod
+    def make(cls, *, seed: int = 0, vol_scale: float = 1.0,
+             mean_shift: float = 0.0,
+             corr_tighten: float = 0.0) -> "RegimeSpec":
+        if float(vol_scale) <= 0.0:
+            raise ValueError(f"vol_scale must be > 0, got {vol_scale}")
+        if not 0.0 <= float(corr_tighten) < 1.0:
+            raise ValueError(f"corr_tighten must be in [0, 1), got "
+                             f"{corr_tighten}")
+        f32 = lambda v: jnp.asarray(float(v), jnp.float32)  # noqa: E731
+        return cls(seed=jnp.asarray(int(seed), jnp.int32),
+                   vol_scale=f32(vol_scale), mean_shift=f32(mean_shift),
+                   corr_tighten=f32(corr_tighten))
+
+    @classmethod
+    def off(cls, seed: int = 0) -> "RegimeSpec":
+        """The identity regime: traces the transform subgraph (same
+        executable as any stressed path) but reproduces the base market
+        bit-for-bit — ``r * 1 + 0`` and ``(1-0) * r + 0 * m`` are exact
+        in IEEE arithmetic. The engine's parity anchor."""
+        return cls.make(seed=seed)
+
+    def transform_returns(self, key, returns: jnp.ndarray) -> jnp.ndarray:
+        """Per-path regime transform of the ``[D, N]`` return panel
+        (traceable). Per-date positive affine, so IC/rank-IC per date are
+        exactly invariant (module docs)."""
+        d = returns.shape[0]
+        s = random.randint(_sub(key, "scenario/regime_break"), (), 0, d)
+        u = random.uniform(_sub(key, "scenario/regime_intensity"), (),
+                           dtype=returns.dtype)
+        after = (jnp.arange(d) >= s)[:, None]
+        one = jnp.ones((), returns.dtype)
+        scale = one + (self.vol_scale.astype(returns.dtype) - one) * u
+        shift = self.mean_shift.astype(returns.dtype) * u
+        c = self.corr_tighten.astype(returns.dtype) * u
+        r = returns * jnp.where(after, scale, one)
+        r = r + jnp.where(after, shift, jnp.zeros((), returns.dtype))
+        ok = ~jnp.isnan(r)
+        n_ok = jnp.maximum(ok.sum(-1, keepdims=True), 1).astype(r.dtype)
+        cross = jnp.where(ok, r, 0.0).sum(-1, keepdims=True) / n_ok
+        tight = (one - c) * r + c * cross
+        return jnp.where(after, tight, r)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class AdversarialSpec:
+    """Scheduled adversarial market corruption (family ``"adversarial"``).
+
+    One sustained window per path (start seeded, length ``window_len``
+    traced); rates are Bernoulli probabilities per date (stale/drop/
+    collapse) or per ``[D, N]`` cell (nan/inf/outlier) INSIDE the window
+    and exactly zero outside it. All-zero rates (:meth:`off`) reproduce
+    the base market bit-for-bit through the same executable.
+    """
+
+    seed: jnp.ndarray           # int32[]
+    window_len: jnp.ndarray     # int32[] sustained-window length (days)
+    nan_rate: jnp.ndarray       # float[] per-cell, inside the window
+    inf_rate: jnp.ndarray       # float[] per-cell
+    outlier_rate: jnp.ndarray   # float[] per-cell
+    outlier_mag: jnp.ndarray    # float[] log10 outlier scale
+    stale_rate: jnp.ndarray    # float[] per-date: re-serve previous date
+    drop_rate: jnp.ndarray     # float[] per-date: date vanishes (NaN)
+    collapse_rate: jnp.ndarray  # float[] per-date: universe collapse
+    collapse_keep: jnp.ndarray  # int32[] names kept on collapsed dates
+
+    @classmethod
+    def make(cls, *, seed: int = 0, window_len: int = 20, nan_rate=0.0,
+             inf_rate=0.0, outlier_rate=0.0, outlier_mag=9.0,
+             stale_rate=0.0, drop_rate=0.0, collapse_rate=0.0,
+             collapse_keep: int = 1) -> "AdversarialSpec":
+        if int(window_len) < 1:
+            raise ValueError(f"window_len must be >= 1, got {window_len}")
+        f32 = lambda v: jnp.asarray(float(v), jnp.float32)  # noqa: E731
+        return cls(seed=jnp.asarray(int(seed), jnp.int32),
+                   window_len=jnp.asarray(int(window_len), jnp.int32),
+                   nan_rate=f32(nan_rate), inf_rate=f32(inf_rate),
+                   outlier_rate=f32(outlier_rate),
+                   outlier_mag=f32(outlier_mag), stale_rate=f32(stale_rate),
+                   drop_rate=f32(drop_rate), collapse_rate=f32(collapse_rate),
+                   collapse_keep=jnp.asarray(int(collapse_keep), jnp.int32))
+
+    @classmethod
+    def off(cls, seed: int = 0) -> "AdversarialSpec":
+        """All-zero rates: the schedule is drawn but corrupts nothing —
+        the clean baseline through the faulted executable."""
+        return cls.make(seed=seed)
+
+    def schedule(self, key, d: int):
+        """Per-path window + day draws (traceable). Returns
+        ``(in_window[D], stale[D], drop[D], collapse[D])`` boolean day
+        masks; day classes are zero outside the window by construction."""
+        wl = jnp.minimum(jnp.maximum(self.window_len, 1), d)
+        # start uniform over the d - wl + 1 VALID placements [0, d - wl]:
+        # the window ending exactly at the last date must be reachable, or
+        # the most recent dates — the ones the exclusive-of-today
+        # selection trades on next — would be structurally exempt from
+        # every adversarial draw
+        lo = jnp.maximum(d - wl + 1, 1)
+        u = random.uniform(_sub(key, "scenario/adv_window"), ())
+        start = (u * lo.astype(u.dtype)).astype(jnp.int32)
+        days = jnp.arange(d)
+        in_win = (days >= start) & (days < start + wl)
+
+        def day_draw(lane, rate, skip_first=False):
+            m = random.uniform(_sub(key, lane), (d,)) < rate
+            m = m & in_win
+            return m & (days > 0) if skip_first else m
+
+        stale = day_draw("scenario/adv_stale", self.stale_rate,
+                         skip_first=True)
+        drop = day_draw("scenario/adv_drop", self.drop_rate)
+        collapse = day_draw("scenario/adv_collapse", self.collapse_rate)
+        return in_win, stale, drop, collapse
+
+    def cell_masks(self, key, shape, in_win) -> tuple:
+        """The three ``bool[D, N]`` cell-corruption masks (NaN burst, Inf
+        spike, outlier blast) inside the window. Drawn ONCE per path at
+        the ``[D, N]`` market-surface granularity: a corrupt symbol-date
+        observation poisons the return panel AND every factor computed
+        from it (:func:`apply_cells` broadcasts over the factor axis) —
+        which is how real vendor-file corruption arrives."""
+        win = in_win[:, None]
+
+        def cell(lane, rate):
+            u = random.uniform(_sub(key, lane), shape)
+            return win & (u < rate.astype(u.dtype))
+
+        return (cell("scenario/adv_nan", self.nan_rate),
+                cell("scenario/adv_inf", self.inf_rate),
+                cell("scenario/adv_outlier", self.outlier_rate))
+
+    def apply_cells(self, x: jnp.ndarray, masks) -> jnp.ndarray:
+        """Apply the :meth:`cell_masks` to a ``[D, N]`` panel or an
+        ``[F, D, N]`` stack (masks broadcast over the factor axis): NaN,
+        then sign-preserving Inf, then the outlier blast — the PR 7 cell
+        semantics restated on the market surface."""
+        nan_m, inf_m, out_m = masks
+        if x.ndim == 3:
+            nan_m, inf_m, out_m = nan_m[None], inf_m[None], out_m[None]
+        x = jnp.where(nan_m, jnp.nan, x)
+        spike = jnp.where(jnp.nan_to_num(x) < 0, -jnp.inf,
+                          jnp.inf).astype(x.dtype)
+        x = jnp.where(inf_m, spike, x)
+        blast = ((jnp.nan_to_num(x) + 1.0)
+                 * 10.0 ** self.outlier_mag.astype(x.dtype))
+        return jnp.where(out_m, blast, x)
+
+
+#: family name -> spec class; the engine dispatches the traced transform
+#: on spec TYPE (a static property), so families never share a trace.
+SCENARIO_FAMILIES = {
+    "bootstrap": BootstrapSpec,
+    "regime": RegimeSpec,
+    "adversarial": AdversarialSpec,
+}
+
+
+def family_of(spec) -> str:
+    """The family name of a spec instance (raises on a foreign type)."""
+    for name, cls in SCENARIO_FAMILIES.items():
+        if isinstance(spec, cls):
+            return name
+    raise TypeError(f"not a scenario spec: {type(spec).__name__} "
+                    f"(families: {sorted(SCENARIO_FAMILIES)})")

@@ -6,6 +6,7 @@ Reference surface: ``factor_selector.py`` + ``factor_selection_methods.py``.
 from factormodeling_tpu.selection.driver import (  # noqa: F401
     build_selection_context,
     finalize_selection,
+    finish_selection_context,
     rolling_selection,
     selection_metric_needs,
 )

@@ -34,7 +34,8 @@ from factormodeling_tpu.selection.selectors import (
 )
 
 __all__ = ["rolling_selection", "build_selection_context",
-           "finalize_selection", "selection_metric_needs"]
+           "finalize_selection", "finish_selection_context",
+           "selection_metric_needs"]
 
 #: daily stats each built-in selector actually reads, as a function of its
 #: method_kwargs (see the selector bodies in selectors.py): icir_top reads
@@ -111,6 +112,20 @@ def _finish_context(metrics_win: dict, factor_ret: jnp.ndarray,
         ret_win_sum=shift(sums, 1, axis=0, fill_value=0.0),
         window=window,
     )
+
+
+def finish_selection_context(metrics_win: dict, factor_ret: jnp.ndarray,
+                             window: int) -> SelectionContext:
+    """Assemble a :class:`SelectionContext` from already-windowed metric
+    tensors (``rolling_metrics`` output, pre-shifted to the exclusive-of-
+    today convention) plus the raw factor returns. Public seam for callers
+    that rebuild the windowed half per market view while HOISTING the
+    per-date stats — the scenario engine gathers ``daily_factor_stats``
+    output along resampled date axes and re-windows per path
+    (:mod:`factormodeling_tpu.scenarios.engine`), reusing exactly this
+    assembly so its context is bit-identical to the driver's on the
+    identity transform."""
+    return _finish_context(metrics_win, factor_ret, window)
 
 
 def selection_metric_needs(method: str, method_kwargs: dict | None = None):

@@ -32,6 +32,7 @@ configurations per dispatch:
 from factormodeling_tpu.serve.batched import (  # noqa: F401
     make_batched_research_step,
     make_tenant_research_step,
+    tenant_step_parts,
 )
 from factormodeling_tpu.serve.frontend import (  # noqa: F401
     DEFAULT_PAD_LADDER,

@@ -41,7 +41,29 @@ from factormodeling_tpu.selection import (
 )
 from factormodeling_tpu.serve.tenant import TenantConfig
 
-__all__ = ["make_tenant_research_step", "make_batched_research_step"]
+__all__ = ["make_tenant_research_step", "make_batched_research_step",
+           "tenant_step_parts"]
+
+
+def tenant_step_parts(names, template: TenantConfig):
+    """The tenant step's two halves, exposed as a public seam:
+    ``(build_ctx, tenant_body)`` where ``build_ctx`` builds the hoistable
+    selection metric context from the market panels and ``tenant_body``
+    runs selector -> mix -> blend -> simulation -> summary against a
+    caller-supplied context. The scenario engine
+    (:mod:`factormodeling_tpu.scenarios`) swaps ``build_ctx`` for a
+    per-path gathered context and feeds ``tenant_body`` per-path
+    transformed panels — reusing this bucket's exact per-tenant program
+    instead of re-deriving it.
+
+    ``tenant_body(tenant, ctx, factors, returns, cap_flag, investability,
+    universe, policy=None)``: with ``policy`` (a
+    :class:`~factormodeling_tpu.resil.policy.DegradePolicy`) the composite
+    is absmax-clamped post-blend and the simulation runs with the
+    policy's hold/carry guards; ``policy=None`` (every serving caller)
+    traces none of that — argument-presence elision, so the serving HLO
+    is byte-identical to pre-round-16 builds."""
+    return _make_parts(names, template)
 
 
 def _make_parts(names, template: TenantConfig):
@@ -77,7 +99,7 @@ def _make_parts(names, template: TenantConfig):
                                            stats=needs)
 
     def tenant_body(t: TenantConfig, ctx, factors, returns, cap_flag,
-                    investability, universe) -> ResearchOutput:
+                    investability, universe, policy=None) -> ResearchOutput:
         kwargs = dict(select_static)
         if select_method == "icir_top":
             kwargs.update(top_x=t.top_k, icir_threshold=t.icir_threshold)
@@ -94,6 +116,15 @@ def _make_parts(names, template: TenantConfig):
                                         method=template.blend_method,
                                         universe=universe,
                                         group_tilt=t.blend_tilt)
+        if policy is not None:
+            # degradation under a policy (the scenario engine's adversarial
+            # grid): post-blend absmax clamp here, hold/carry guards via
+            # settings.degrade below. None — every serving caller — traces
+            # none of this (argument-presence elision).
+            from factormodeling_tpu.resil import policy as resil_policy
+
+            with obs_stage("resil/clamp"):
+                signal, _, _ = resil_policy.clamp_signal(signal, policy)
         settings = SimulationSettings(
             returns=returns, cap_flag=cap_flag,
             investability_flag=investability, universe=universe,
@@ -102,7 +133,7 @@ def _make_parts(names, template: TenantConfig):
             shrinkage_intensity=t.shrinkage_intensity,
             turnover_penalty=t.turnover_penalty,
             return_weight=t.return_weight, tcost_scale=t.tcost_scale,
-            **sim_static)
+            degrade=policy, **sim_static)
         sim = run_simulation(signal, settings)
         with obs_stage("pipeline/summary"):
             summary = result_summary(sim.result)

@@ -69,6 +69,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from factormodeling_tpu import rng as rng_lanes
 from factormodeling_tpu.obs.latency import QuantileSketch
 from factormodeling_tpu.obs.report import active_report, record_stage
 from factormodeling_tpu.resil import checkpoint as _ckpt
@@ -135,11 +136,15 @@ class VirtualClock:
 def poisson_arrivals(n: int, *, rate_hz: float, seed: int = 0,
                      start_s: float = 0.0) -> np.ndarray:
     """``n`` open-loop Poisson arrival times (absolute virtual seconds):
-    i.i.d. exponential gaps at ``rate_hz``, seeded and deterministic."""
+    i.i.d. exponential gaps at ``rate_hz``, seeded and deterministic.
+    Draws under the central RNG lane registry
+    (:mod:`factormodeling_tpu.rng`, round 16), so a poisson and a bursty
+    trace at the SAME seed are independent streams — they used to share
+    one gap stream, the ad-hoc-seed collision the registry fixed."""
     if n < 0 or rate_hz <= 0:
         raise ValueError(f"need n >= 0 and rate_hz > 0, got {n}, {rate_hz}")
-    gaps = np.random.default_rng(int(seed)).exponential(1.0 / rate_hz,
-                                                        size=int(n))
+    gaps = rng_lanes.lane_rng("serve/arrivals/poisson", seed).exponential(
+        1.0 / rate_hz, size=int(n))
     return start_s + np.cumsum(gaps)
 
 
@@ -154,7 +159,7 @@ def bursty_arrivals(n: int, *, rate_hz: float, burst: int = 8,
     if burst < 1:
         raise ValueError(f"burst must be >= 1, got {burst}")
     n_bursts = -(-int(n) // int(burst))
-    gaps = np.random.default_rng(int(seed)).exponential(
+    gaps = rng_lanes.lane_rng("serve/arrivals/bursty", seed).exponential(
         burst / rate_hz, size=n_bursts)
     starts = start_s + np.cumsum(gaps)
     return np.repeat(starts, burst)[:int(n)]

@@ -104,6 +104,96 @@ def test_serving_preset_smoke():
         + degrade["shed_count"] > 0
 
 
+def test_scenario_preset_smoke():
+    """The round-16 scenario grid: scenario family x degrade policy,
+    each cell a vmapped stressed-market sweep — every cell produces
+    finite risk rows and holds the production invariants on every
+    path's book, the guard policy visibly degrades under the
+    adversarial family, and the default policy stays inert."""
+    verdict = chaos.run_scenario_chaos(
+        shape=(4, 36, 12), window=6, method="equal",
+        families=["bootstrap", "regime", "adversarial"],
+        policies=["default", "guard", "full"],
+        n_paths=4, seed=3, progress=lambda _m: None)
+    assert verdict["cells"] == 9
+    assert verdict["ok"], verdict["failed"]
+    adv_guard = verdict["results"]["scenario/adversarial/guard"]
+    assert adv_guard["quarantined_days"] + adv_guard["held_days"] > 0
+    for cell, res in verdict["results"].items():
+        assert res["nonfinite_paths"] == 0, cell
+        if res["policy"] == "default":
+            # the inert policy never degrades: the engine's ladder alone
+            # absorbs the stress
+            assert res.get("quarantined_days", 0) == 0, cell
+            assert res.get("held_days", 0) == 0, cell
+
+
+def test_scenario_preset_emits_risk_rows_on_the_report():
+    """Each grid cell's run_scenarios lands kind="scenario" VaR/ES rows
+    on the shared report (the acceptance artifact trace_report renders
+    and report_diff gates), plus one kind="scenario_cell" verdict row."""
+    from factormodeling_tpu import obs
+
+    rep = obs.RunReport("grid")
+    verdict = chaos.run_scenario_chaos(
+        shape=(4, 36, 12), window=6, method="equal",
+        families=["bootstrap"], policies=["default"], n_paths=3, seed=1,
+        report=rep, progress=lambda _m: None)
+    assert verdict["ok"]
+    risk = [r for r in rep.rows if r.get("kind") == "scenario"]
+    assert {r["metric"] for r in risk} >= {"pnl_total", "max_drawdown"}
+    assert all(r["name"].startswith("scenario/bootstrap/default/")
+               for r in risk)
+    cells = [r for r in rep.rows if r.get("kind") == "scenario_cell"]
+    assert len(cells) == 1 and cells[0]["ok"]
+
+
+SCENARIO_CLI = [sys.executable, str(REPO / "tools" / "chaos.py"),
+                "--scenarios", "--shape", "4,36,12", "--window", "6",
+                "--method", "equal", "--faults", "bootstrap,adversarial",
+                "--policies", "default,guard", "--paths", "4",
+                "--seed", "3", "--json"]
+
+
+def test_scenario_cli_kill_resume_differential(tmp_path):
+    """The --scenarios preset rides the shared CellLoop: a run killed
+    right after a cell's snapshot (the _FMT_CHAOS_DIE_AFTER_CELL hook)
+    resumes from its checkpoint and the final verdict JSON is byte-equal
+    to a straight-through run."""
+    env = {**os.environ}
+    straight = subprocess.run(SCENARIO_CLI, capture_output=True, text=True,
+                              env=env, timeout=420)
+    assert straight.returncode == 0, straight.stderr[-2000:]
+
+    ck = tmp_path / "scen.ckpt"
+    killed = subprocess.run(
+        SCENARIO_CLI + ["--checkpoint", str(ck)], capture_output=True,
+        text=True, timeout=420,
+        env={**env, "_FMT_CHAOS_DIE_AFTER_CELL": "1"})
+    assert killed.returncode == 137, killed.stderr[-2000:]
+    assert "chaos-scenarios: dying after cell 1" in killed.stderr
+
+    report = tmp_path / "resumed.jsonl"
+    resumed = subprocess.run(
+        SCENARIO_CLI + ["--checkpoint", str(ck), "--report", str(report)],
+        capture_output=True, text=True, env=env, timeout=420)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    assert "chaos-scenarios: resumed 2/4 cells" in resumed.stderr
+    assert resumed.stdout == straight.stdout  # byte-equal verdict JSON
+    verdict = json.loads(resumed.stdout)
+    assert verdict["ok"] and verdict["cells"] == 4
+    # the resumed report CONTINUES the killed run: every cell's verdict
+    # row present exactly once, the pre-kill cells' risk rows restored
+    # from the snapshot
+    rows = [json.loads(line) for line in report.read_text().splitlines()]
+    cell_rows = [r["name"] for r in rows
+                 if r.get("kind") == "scenario_cell"]
+    assert sorted(cell_rows) == sorted(verdict["results"])
+    risk_cells = {r["name"].rsplit("/", 1)[0] for r in rows
+                  if r.get("kind") == "scenario"}
+    assert risk_cells == set(verdict["results"])
+
+
 CLI = [sys.executable, str(REPO / "tools" / "chaos.py"),
        "--shape", "4,24,10", "--window", "6", "--method", "equal",
        "--faults", "nan_burst,universe_collapse", "--policies",

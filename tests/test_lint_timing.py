@@ -100,6 +100,18 @@ def test_default_targets_cover_the_serving_layer():
     assert "retry.py" in resil
 
 
+def test_default_targets_cover_the_scenario_engine():
+    """Round 16 extends the surface over factormodeling_tpu/scenarios/:
+    the engine's chunked host sweep loop is exactly where an ad-hoc
+    unfenced paths/s window would be tempting and wrong — the vmapped
+    dispatch returns before a single path has computed. Pinned by name so
+    a future move out of scenarios/ can't silently drop them from the
+    linted surface."""
+    targets = lint_timing.default_targets(REPO)
+    scen = {p.name for p in targets if p.parent.name == "scenarios"}
+    assert {"engine.py", "risk.py", "spec.py"} <= scen
+
+
 def _lint_snippet(tmp_path, code):
     f = tmp_path / "snippet.py"
     f.write_text(textwrap.dedent(code))

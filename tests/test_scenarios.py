@@ -1,0 +1,467 @@
+"""The round-16 scenario engine (factormodeling_tpu.scenarios): vmapped
+stress markets, counterfactual paths, and distributional risk analytics.
+
+The load-bearing pins:
+
+- **identity parity** — the identity regime (``RegimeSpec.off``) runs
+  every path BIT-EQUAL to the single-market tenant step through the
+  path-vmapped engine, which simultaneously proves the per-path context
+  reconstruction (hoisted daily stats -> gather -> re-window) matches the
+  driver's ``build_selection_context`` exactly;
+- **the path-axis hoist rule** — no sort touches a ``[P, F, D, N]``
+  operand in the optimized HLO, while the ``[F, D, N]`` metric-stack
+  sort exists unbatched (the section-22 analogue of PR 9's pin);
+- **sketch-merge invariance** — chunking, lax.map chunking, and
+  kill/resume through ``resil.checkpoint`` all produce risk rows
+  BIT-EQUAL to a straight-through sweep (the PR 8 sketches merge
+  exactly);
+- **structural elision** — the default research step reproduces its bits
+  with ``factormodeling_tpu.scenarios`` made unimportable (the PR 7/10
+  subprocess pin).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu import resil, scenarios
+from factormodeling_tpu.scenarios.risk import (
+    RiskAccumulator,
+    SignedSketch,
+)
+from factormodeling_tpu.serve import TenantConfig, make_tenant_research_step
+
+REPO = Path(__file__).resolve().parent.parent
+
+NAMES = ("mom_eq", "val_flx", "qual_long", "size_short", "rev_flx")
+F, D, N = len(NAMES), 48, 16
+WINDOW = 8
+
+
+@pytest.fixture(scope="module")
+def market():
+    rng = np.random.default_rng(20260804)
+    return dict(
+        factors=jnp.asarray(rng.normal(size=(F, D, N)).astype(np.float32)),
+        returns=jnp.asarray(
+            rng.normal(scale=0.02, size=(D, N)).astype(np.float32)),
+        factor_ret=jnp.asarray(
+            rng.normal(scale=0.01, size=(D, F)).astype(np.float32)),
+        cap_flag=jnp.asarray(
+            rng.integers(1, 4, size=(D, N)).astype(np.float32)),
+        investability=jnp.ones((D, N), jnp.float32),
+        universe=jnp.ones((D, N), bool),
+    )
+
+
+def template(**kw):
+    base = dict(top_k=2, icir_threshold=-1.0, method="equal",
+                window=WINDOW, max_weight=0.5, pct=0.25)
+    base.update(kw)
+    return TenantConfig(**base)
+
+
+# ----------------------------------------------------- identity parity
+
+
+def test_identity_regime_paths_are_bit_equal_to_the_single_step(market):
+    """RegimeSpec.off() paths reproduce the single-market tenant step
+    bit-for-bit through the vmapped engine — the parity anchor that also
+    proves the hoisted-context reconstruction is exact."""
+    tpl = template()
+    res = scenarios.run_scenarios(
+        names=NAMES, template=tpl, spec=scenarios.RegimeSpec.off(seed=3),
+        n_paths=3, chunk=3, return_books=True, **market)
+    step = make_tenant_research_step(names=NAMES, template=tpl)
+    tenant = tpl.normalized(F, 5, dtype=np.float32)
+    base = jax.jit(step)(tenant, market["factors"], market["returns"],
+                         market["factor_ret"], market["cap_flag"],
+                         market["investability"], market["universe"])
+    want_w = np.nan_to_num(np.asarray(base.sim.weights))
+    want_s = np.nan_to_num(np.asarray(base.signal))
+    for p in range(3):
+        book = res.book(p)
+        np.testing.assert_array_equal(
+            np.nan_to_num(np.asarray(book.sim.weights)), want_w)
+        np.testing.assert_array_equal(
+            np.nan_to_num(np.asarray(book.signal)), want_s)
+        assert float(book.summary.total_log_return) == \
+            float(base.summary.total_log_return)
+
+
+def test_bootstrap_paths_differ_and_day_indices_are_valid(market):
+    """Distinct paths resample distinct date sequences; every index is in
+    range; per-path metrics are finite."""
+    spec = scenarios.BootstrapSpec.make(seed=5, block_len=10)
+    for p in (0, 1, 2):
+        idx = np.asarray(spec.day_index(scenarios.path_key(spec, p), D))
+        assert idx.shape == (D,)
+        assert (0 <= idx).all() and (idx < D).all()
+    i0 = np.asarray(spec.day_index(scenarios.path_key(spec, 0), D))
+    i1 = np.asarray(spec.day_index(scenarios.path_key(spec, 1), D))
+    assert not np.array_equal(i0, i1)
+    res = scenarios.run_scenarios(names=NAMES, template=template(),
+                                  spec=spec, n_paths=5, chunk=5, **market)
+    assert res.finite_ok and res.n_paths == 5
+    pnl = next(r for r in res.rows if r["metric"] == "pnl_total")
+    assert pnl["paths"] == 5
+    assert all(np.isfinite(v) for v in pnl["var"] + pnl["es"])
+
+
+def test_regime_stress_moves_the_pnl_distribution(market):
+    """A severe regime (vol x3, bear drift) must WIDEN the pnl
+    distribution versus the identity regime — the engine's sanity check
+    that the transform actually reaches the backtest."""
+    kw = dict(names=NAMES, template=template(), n_paths=8, chunk=8,
+              **market)
+    calm = scenarios.run_scenarios(
+        spec=scenarios.RegimeSpec.off(seed=2), **kw)
+    stressed = scenarios.run_scenarios(
+        spec=scenarios.RegimeSpec.make(seed=2, vol_scale=3.0,
+                                       mean_shift=-0.02,
+                                       corr_tighten=0.5), **kw)
+    calm_pnl = next(r for r in calm.rows if r["metric"] == "pnl_total")
+    hot_pnl = next(r for r in stressed.rows if r["metric"] == "pnl_total")
+    # identity paths all collapse to one value; stressed paths spread
+    assert calm_pnl["hi"] == calm_pnl["lo"]
+    assert hot_pnl["hi"] > hot_pnl["lo"]
+
+
+def test_adversarial_faults_are_confined_to_the_schedule(market):
+    """Day draws land inside the per-path sustained window only, and the
+    all-zero-rate spec is the bitwise identity (the clean baseline
+    through the faulted executable)."""
+    spec = scenarios.AdversarialSpec.make(seed=6, window_len=12,
+                                          stale_rate=0.5, drop_rate=0.5,
+                                          collapse_rate=0.5)
+    for p in range(4):
+        key = scenarios.path_key(spec, p)
+        in_win, stale, drop, collapse = spec.schedule(key, D)
+        in_win = np.asarray(in_win)
+        assert in_win.sum() == 12
+        start = int(np.argmax(in_win))
+        assert in_win[start:start + 12].all()
+        for mask in (stale, drop, collapse):
+            assert not (np.asarray(mask) & ~in_win).any()
+    off = scenarios.AdversarialSpec.off(seed=6)
+    res = scenarios.run_scenarios(names=NAMES, template=template(),
+                                  spec=off, n_paths=2, chunk=2,
+                                  return_books=True, **market)
+    tpl = template()
+    step = make_tenant_research_step(names=NAMES, template=tpl)
+    base = jax.jit(step)(tpl.normalized(F, 5, dtype=np.float32),
+                         market["factors"], market["returns"],
+                         market["factor_ret"], market["cap_flag"],
+                         market["investability"], market["universe"])
+    np.testing.assert_array_equal(
+        np.nan_to_num(np.asarray(res.book(0).sim.weights)),
+        np.nan_to_num(np.asarray(base.sim.weights)))
+
+
+def test_adversarial_with_policy_degrades_and_stays_finite(market):
+    """The acceptance-grid cell semantics: a hostile sustained window
+    under a guard policy produces finite risk rows with the degrade
+    guards visibly engaging (held/quarantined days counted)."""
+    spec = scenarios.AdversarialSpec.make(
+        seed=4, window_len=16, nan_rate=0.15, inf_rate=0.05,
+        outlier_rate=0.05, stale_rate=0.2, drop_rate=0.25,
+        collapse_rate=0.3, collapse_keep=1)
+    pol = resil.DegradePolicy.make(min_universe=4, carry_fallback=True,
+                                   quarantine_nan_frac=0.3,
+                                   clamp_absmax=10.0)
+    res = scenarios.run_scenarios(names=NAMES, template=template(),
+                                  spec=spec, policy=pol, n_paths=6,
+                                  chunk=6, **market)
+    assert res.finite_ok
+    assert res.degrade["held_days"] > 0
+    assert res.degrade["quarantined_days"] > 0
+    for row in res.rows:
+        assert row["nonfinite_paths"] == 0
+        assert all(np.isfinite(v) for v in row["var"] + row["es"])
+
+
+# ------------------------------------------------- the path-axis hoist
+
+
+def test_no_sort_touches_a_path_batched_stack(market):
+    """Structural pin on the hoist rule (the section-22 analogue of
+    PR 9's [C, F, D, N] pin): the metric stack's rank sort appears at
+    its UNBATCHED [F, D, N] shape and NO sort ever touches a
+    [P, F, D, N] operand — for the families whose markets genuinely
+    vary per path."""
+    p = 6
+    tpl = template()
+    tenant = tpl.normalized(F, 5, dtype=np.float32)
+    px = jnp.arange(p, dtype=jnp.int32)
+    args = (market["factors"], market["returns"], market["factor_ret"],
+            market["cap_flag"], market["investability"],
+            market["universe"])
+    for family, spec in (
+            ("bootstrap", scenarios.BootstrapSpec.make(seed=1,
+                                                       block_len=8)),
+            ("adversarial", scenarios.AdversarialSpec.make(
+                seed=1, nan_rate=0.1, drop_rate=0.1))):
+        step = scenarios.make_scenario_step(names=NAMES, template=tpl,
+                                            family=family)
+        hlo = jax.jit(step).lower(tenant, spec, None, px,
+                                  *args).compile().as_text()
+        sort_lines = [ln for ln in hlo.splitlines() if "sort(" in ln]
+        assert sort_lines, family
+        assert any(f"[{F},{D},{N}]" in ln for ln in sort_lines), family
+        assert not any(f"[{p},{F},{D},{N}]" in ln for ln in sort_lines), \
+            (family, [ln for ln in sort_lines
+                      if f"[{p},{F},{D},{N}]" in ln])
+
+
+# --------------------------------------------- sketch-merge invariance
+
+
+def test_chunking_and_lax_map_cannot_change_the_rows(market):
+    """K-chunk sweeps (including a ragged tail chunk) and lax.map-chunked
+    dispatches produce risk rows BIT-EQUAL to the one-shot sweep — the
+    sketch-merge invariance the engine's resume story rests on."""
+    spec = scenarios.BootstrapSpec.make(seed=2, block_len=8)
+    kw = dict(names=NAMES, template=template(), spec=spec, n_paths=7,
+              **market)
+    one_shot = scenarios.run_scenarios(chunk=7, **kw)
+    for chunk in (1, 2, 3, 4):  # 7/2 and 7/3 and 7/4 have ragged tails
+        chunked = scenarios.run_scenarios(chunk=chunk, **kw)
+        assert json.dumps(chunked.rows, sort_keys=True) == \
+            json.dumps(one_shot.rows, sort_keys=True), chunk
+    plain = scenarios.run_scenarios(
+        names=NAMES, template=template(), spec=spec, n_paths=7, chunk=7,
+        **market)
+    # map_chunk with a dividing width AND with a ragged tail (7 = 3+3+1:
+    # lax.map head + vmapped remainder — the review-found crash case)
+    for mc in (7, 3, 2):
+        mapped = scenarios.run_scenarios(
+            names=NAMES, template=template(), spec=spec, n_paths=7,
+            chunk=7, map_chunk=mc, **market)
+        assert json.dumps(mapped.rows, sort_keys=True) == \
+            json.dumps(plain.rows, sort_keys=True), mc
+
+
+def test_sketch_merge_is_associative_bit_for_bit():
+    """The satellite pin at the sketch level: K-chunk merges of the
+    SignedSketch / RiskAccumulator equal the one-shot fold bit-for-bit
+    for several chunkings, including a ragged tail."""
+    rng = np.random.default_rng(7)
+    values = rng.normal(scale=0.3, size=101).tolist()  # signed, ragged
+    one = SignedSketch()
+    for v in values:
+        one.add(v)
+    # K-chunk merges — contiguous (the sweep's chunking, including the
+    # ragged 101 % k tail) AND interleaved — reproduce the one-shot
+    # sketch bit-for-bit in everything the quantiles and VaR/ES read:
+    # bucket vectors, counts, min/max. The float `total` is a SUM, so a
+    # partial-sum merge tree reassociates its last bits — pinned to
+    # float tolerance here; the ENGINE's bit-equal resume contract holds
+    # because run_scenarios folds path-by-path into ONE accumulator and
+    # snapshots it at full precision (the kill/resume test above).
+    for k in (2, 3, 7, 10):
+        for chunks in (
+                [values[i::k] for i in range(k)],                # interleaved
+                [values[lo:lo + -(-101 // k)]                    # contiguous,
+                 for lo in range(0, 101, -(-101 // k))]):        # ragged tail
+            merged = SignedSketch()
+            for ch in chunks:
+                part = SignedSketch()
+                for v in ch:
+                    part.add(v)
+                merged.merge(part)
+            for half in ("neg", "pos"):
+                a, b = merged.state()[half], one.state()[half]
+                assert {key: v for key, v in a.items()
+                        if key != "total"} \
+                    == {key: v for key, v in b.items()
+                        if key != "total"}, k
+                assert a["total"] == pytest.approx(b["total"], rel=1e-12)
+            for q in (0.01, 0.5, 0.95, 0.99):
+                assert merged.quantile(q) == one.quantile(q)
+    # the accumulator inherits it metric-wise, and never aliases the
+    # merged-in accumulator's sketches
+    a, b = RiskAccumulator(), RiskAccumulator()
+    for i, v in enumerate(values):
+        (a if i % 2 else b).observe("pnl_total", v)
+    total = RiskAccumulator().merge(a).merge(b)
+    direct = RiskAccumulator()
+    for v in values:
+        direct.observe("pnl_total", v)
+    assert total.rows("x") == direct.rows("x")
+    before = json.dumps(a.state(), sort_keys=True)
+    total.observe("pnl_total", 1.0)
+    assert json.dumps(a.state(), sort_keys=True) == before
+
+
+def test_signed_sketch_var_es_orientation():
+    """VaR/ES semantics: loss orientation for bad-down metrics (PnL),
+    raw upper tail for bad-up (drawdown); both within a bucket width of
+    the exact sample statistic and clamped into the observed range."""
+    sk = SignedSketch()
+    values = [-0.5, -0.2, -0.1, 0.0, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6]
+    for v in values:
+        sk.add(v)
+    var, es = sk.var_es(0.9, "down")
+    # 10% worst tail = the -0.5 path: VaR ~ 0.5 loss, ES >= VaR
+    assert var == pytest.approx(0.5, rel=0.10)
+    assert es >= var * 0.9
+    # bad-up at 0.9: the rank-ceil(0.9*10)=9th smallest is 0.5; the
+    # 1-observation tail mean is the 0.6 max (clamped into the range)
+    var_up, es_up = sk.var_es(0.9, "up")
+    assert var_up == pytest.approx(0.5, rel=0.10)
+    assert es_up == pytest.approx(0.6, rel=0.10)
+    with pytest.raises(ValueError, match="bad_direction"):
+        sk.var_es(0.9, "sideways")
+    with pytest.raises(ValueError, match="finite"):
+        sk.add(float("nan"))
+
+
+def test_kill_resume_is_bit_equal_to_straight_through(tmp_path, market):
+    """The PR 7 pattern on the path sweep: kill mid-sweep (the
+    checkpoint-then-stop seam), rerun the same call, and the final risk
+    rows are BIT-EQUAL to a straight-through run. A checkpoint from a
+    DIFFERENT spec is refused by the content fingerprint."""
+    kw = dict(names=NAMES, template=template(),
+              spec=scenarios.BootstrapSpec.make(seed=9, block_len=6),
+              n_paths=10, chunk=3, **market)
+    straight = scenarios.run_scenarios(**kw)
+    ck = tmp_path / "scen.ckpt"
+    os.environ["_FMT_SCEN_STOP_AFTER_CHUNK"] = "2"
+    try:
+        partial = scenarios.run_scenarios(checkpoint_path=ck, **kw)
+    finally:
+        del os.environ["_FMT_SCEN_STOP_AFTER_CHUNK"]
+    assert not partial.completed and partial.rows == []
+    assert ck.exists()
+    resumed = scenarios.run_scenarios(checkpoint_path=ck, **kw)
+    assert resumed.completed
+    assert json.dumps(resumed.rows, sort_keys=True) == \
+        json.dumps(straight.rows, sort_keys=True)
+    # a different spec must NOT resume the old snapshot (fingerprint
+    # guard): the run completes fresh with its own rows
+    other = dict(kw)
+    other["spec"] = scenarios.BootstrapSpec.make(seed=10, block_len=6)
+    fresh = scenarios.run_scenarios(checkpoint_path=ck, **other)
+    assert fresh.completed
+    assert json.dumps(fresh.rows, sort_keys=True) != \
+        json.dumps(straight.rows, sort_keys=True)
+
+
+def test_return_books_with_checkpoint_is_rejected(market):
+    with pytest.raises(ValueError, match="return_books"):
+        scenarios.run_scenarios(
+            names=NAMES, template=template(),
+            spec=scenarios.BootstrapSpec.make(seed=1),
+            checkpoint_path="/tmp/never", return_books=True, **market)
+
+
+# ------------------------------------------------------ report plumbing
+
+
+def test_scenario_rows_land_on_reports_and_render(market):
+    """run_scenarios(report=...) records kind="scenario" rows that
+    trace_report renders (scenario section) and passes --strict."""
+    from factormodeling_tpu import obs
+
+    if str(REPO / "tools") not in sys.path:
+        sys.path.insert(0, str(REPO / "tools"))
+    import trace_report
+
+    rep = obs.RunReport("scen")
+    res = scenarios.run_scenarios(
+        names=NAMES, template=template(),
+        spec=scenarios.BootstrapSpec.make(seed=3, block_len=8),
+        n_paths=4, chunk=4, report=rep, tag="scenarios/test", **market)
+    rows = [r for r in rep.rows if r.get("kind") == "scenario"]
+    assert {r["metric"] for r in rows} == set(res.nonfinite) | {
+        "pnl_total", "max_drawdown", "mean_turnover", "worst_day_loss"}
+    assert trace_report.malformed_rows(rows) == []
+    rendered = trace_report.render(rows)
+    assert "scenario risk" in rendered
+    assert "scenarios/test/pnl_total" in rendered
+
+
+# --------------------------------------------------- structural elision
+
+
+def test_default_step_elides_the_scenario_package(tmp_path, market):
+    """PR 7/10-style unimportable pin: with factormodeling_tpu.scenarios
+    BLOCKED from importing, the default research step builds, runs, and
+    reproduces bit-identical outputs — the scenario engine is a pure
+    add-on the default path never touches."""
+    from factormodeling_tpu.parallel import build_research_step
+
+    step = jax.jit(build_research_step(names=NAMES, window=WINDOW,
+                                       sim_kwargs=dict(method="equal")))
+    want = np.nan_to_num(np.asarray(step(
+        market["factors"], market["returns"], market["factor_ret"],
+        market["cap_flag"], market["investability"],
+        market["universe"]).sim.weights))
+    market_path = tmp_path / "market.npz"
+    weights_path = tmp_path / "weights.npy"
+    np.savez(market_path, **{k: np.asarray(v) for k, v in market.items()})
+    script = f"""
+import sys
+sys.path.insert(0, {str(REPO)!r})
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name.startswith("factormodeling_tpu.scenarios"):
+            raise ImportError(f"{{name}} is blocked for the elision pin")
+        return None
+sys.meta_path.insert(0, _Block())
+import os
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from factormodeling_tpu.parallel import build_research_step
+market = np.load({str(market_path)!r}, allow_pickle=False)
+step = jax.jit(build_research_step(names={NAMES!r}, window={WINDOW},
+                                   sim_kwargs=dict(method="equal")))
+out = step(market["factors"], market["returns"], market["factor_ret"],
+           market["cap_flag"], market["investability"],
+           market["universe"])
+assert not any(m.startswith("factormodeling_tpu.scenarios")
+               for m in sys.modules)
+np.save({str(weights_path)!r},
+        np.nan_to_num(np.asarray(out.sim.weights)))
+print("ELISION_OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ELISION_OK" in proc.stdout
+    np.testing.assert_array_equal(np.load(weights_path), want)
+
+
+def test_serving_path_is_untouched_by_the_policy_seam(market):
+    """The tenant_body policy seam (round 16) must not change the
+    serving layer's trace: a policy=None serve produces bit-identical
+    outputs to the same serve before the seam existed — pinned by
+    serving a config and checking its lanes against the oracle-pinned
+    single-config step (which shares the seam, so this pins their
+    AGREEMENT, while test_serve.py's differentials pin both against the
+    pre-round-16 pipeline)."""
+    from factormodeling_tpu.serve import TenantServer
+
+    server = TenantServer(names=NAMES, **{
+        k: np.asarray(v) for k, v in market.items()})
+    cfg = template()
+    out = server.serve([cfg])[0].output
+    step = make_tenant_research_step(names=NAMES, template=cfg)
+    base = jax.jit(step)(cfg.normalized(F, 5, dtype=np.float32),
+                         market["factors"], market["returns"],
+                         market["factor_ret"], market["cap_flag"],
+                         market["investability"], market["universe"])
+    np.testing.assert_array_equal(
+        np.nan_to_num(np.asarray(out.sim.weights)),
+        np.nan_to_num(np.asarray(base.sim.weights)))

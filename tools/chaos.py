@@ -37,6 +37,16 @@ every dispatch; the ``_FMT_SERVE_DIE_AFTER_DISPATCH`` env hook kills the
 process mid-drain and a rerun resumes byte-equal (the kill/resume
 differential in tests/test_serve_queue.py).
 
+``--scenarios`` switches to the round-16 SCENARIO preset
+(``factormodeling_tpu.scenarios``, architecture.md §22): each cell runs a
+vmapped sweep of stressed MARKETS (bootstrap-resampled, regime-shifted,
+or adversarially corrupted paths) through one tenant config under a
+degrade policy, asserting finite VaR/ES/drawdown risk rows (``kind=
+"scenario"`` rows land on the report) plus the production invariants
+above on every path's served book. ``--faults`` selects the families,
+``--policies`` the same four policy presets as the matrix; checkpointed
+cell resume works identically (the shared :class:`CellLoop`).
+
 Usage::
 
     python tools/chaos.py [--shape F,D,N] [--window 8]
@@ -44,6 +54,7 @@ Usage::
         [--rate 0.05] [--day-rate 0.2] [--seed 0] [--tol 0.05]
         [--report chaos_report.jsonl] [--checkpoint chaos.ckpt] [--json]
         [--serving] [--requests 24] [--load 1.5]
+        [--scenarios] [--paths 6]
 
 Exit codes: 0 = every cell satisfied every invariant; 1 = at least one
 violation (each printed with its cell and invariant); 2 = bad usage.
@@ -55,6 +66,7 @@ import argparse
 import json
 import os
 import sys
+import zlib
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -77,6 +89,85 @@ _DAY_CLASSES = ("stale_repeat", "drop_day", "universe_collapse")
 #: test hook: die WITHOUT cleanup right after checkpointing this 0-based
 #: cell index — the mid-run-kill case of the resume differential test.
 _DIE_ENV = "_FMT_CHAOS_DIE_AFTER_CELL"
+
+
+class CellLoop:
+    """The cell-loop scaffolding every preset shares (extracted round 16;
+    deliberately deferred in round 15 while the presets were still
+    diverging): report-row marking, checkpointed done-cell resume with
+    snapshot row REPLACEMENT, per-cell save, and the kill test hook.
+
+    Contracts carried over verbatim (the kill/resume CLI differentials in
+    tests/test_chaos.py are byte-equal before and after the extraction):
+
+    - rows recorded from ``mark`` on belong to THIS loop: snapshot saves
+      serialize ``rep.rows[mark:]`` and resume REPLACES that slice with
+      the snapshot's, so a resumed report CONTINUES the killed run's rows
+      (exactly one baseline block) while rows a caller recorded
+      beforehand stay put;
+    - cell verdicts snapshot as sorted-key JSON strings (deterministic
+      payloads — byte-equal snapshots for identical runs);
+    - ``die_env``: after the save of cell index ``int(os.environ[die_env])``
+      the process exits 137 without cleanup — the mid-run SIGKILL of the
+      resume differential.
+    """
+
+    def __init__(self, rep, *, label, n_cells, mark, ck_meta=None,
+                 checkpoint_path=None, checkpoint_every=1, progress=print,
+                 die_env=None):
+        self.rep = rep
+        self.label = label
+        self.mark = mark
+        self.ck_meta = ck_meta
+        self.progress = progress
+        self.die_env = die_env
+        self.done: dict = {}
+        self.ck = None
+        if checkpoint_path is not None:
+            from factormodeling_tpu import resil
+
+            self.ck = resil.Checkpointer(checkpoint_path,
+                                         every=checkpoint_every)
+            got = self.ck.resume(expect_meta=ck_meta)
+            if got is not None:
+                state, _ = got
+                self.done = {k: json.loads(v)
+                             for k, v in state["done"].items()}
+                rep.rows[mark:] = [json.loads(row)
+                                   for row in state.get("report_rows", [])]
+                progress(f"{label}: resumed {len(self.done)}/{n_cells} "
+                         f"cells from {checkpoint_path}")
+
+    def skip(self, cell: str) -> bool:
+        """True when the cell's verdict was resumed from the snapshot."""
+        return cell in self.done
+
+    def complete(self, idx: int, cell: str, result: dict) -> None:
+        """Record one finished cell: verdict kept, snapshot saved on the
+        checkpoint grid, kill hook honored AFTER the save (the snapshot a
+        resumed run continues from must include this cell)."""
+        self.done[cell] = result
+        if self.ck is None:
+            return
+        self.ck.maybe_save(
+            idx, {"done": {k: json.dumps(v, sort_keys=True)
+                           for k, v in self.done.items()},
+                  "report_rows": [json.dumps(r, sort_keys=True, default=str)
+                                  for r in self.rep.rows[self.mark:]]},
+            meta=self.ck_meta)
+        if self.die_env is not None:
+            die_after = os.environ.get(self.die_env)
+            if die_after is not None and idx == int(die_after):
+                self.progress(f"{self.label}: dying after cell {idx} "
+                              f"({self.die_env} test hook)")
+                os._exit(137)
+
+    def verdict(self, cells) -> dict:
+        """The preset's JSON-ready verdict over every done cell."""
+        failures = {k: v for k, v in self.done.items() if not v["ok"]}
+        return {"ok": not failures, "cells": len(cells),
+                "failed": sorted(failures),
+                "results": {k: self.done[k] for k in sorted(self.done)}}
 
 
 def make_inputs(f: int, d: int, n: int, seed: int = 0):
@@ -208,8 +299,6 @@ def run_chaos(*, shape=(6, 48, 16), window: int = 8,
                              f"{sorted(all_policies)}")
 
         cells = [(fk, pk) for fk in faults for pk in policies]
-        done: dict[str, dict] = {}
-        ck = None
         ck_meta = {"entry": "chaos",
                    "config": [list(shape), window, method, faults, policies,
                               float(rate), float(day_rate), int(seed),
@@ -217,26 +306,13 @@ def run_chaos(*, shape=(6, 48, 16), window: int = 8,
                               # were JUDGED under it — resuming them into a
                               # stricter run would serve stale oks
                               float(tol)]}
-        if checkpoint_path is not None:
-            ck = resil.Checkpointer(checkpoint_path, every=checkpoint_every)
-            got = ck.resume(expect_meta=ck_meta)
-            if got is not None:
-                state, _ = got
-                done = {k: json.loads(v) for k, v in state["done"].items()}
-                # REPLACE this run's rows-so-far with the snapshot's
-                # (which start with the killed run's baseline block): the
-                # resumed report is a continuation of the original run,
-                # not a second run with a duplicate baseline appended —
-                # while rows the caller recorded before us stay put
-                rep.rows[mark:] = [json.loads(row)
-                                   for row in state.get("report_rows", [])]
-                progress(f"chaos: resumed {len(done)}/{len(cells)} cells "
-                         f"from {checkpoint_path}")
-
-        die_after = os.environ.get(_DIE_ENV)
+        loop = CellLoop(rep, label="chaos", n_cells=len(cells), mark=mark,
+                        ck_meta=ck_meta, checkpoint_path=checkpoint_path,
+                        checkpoint_every=checkpoint_every,
+                        progress=progress, die_env=_DIE_ENV)
         for idx, (fault, pol_name) in enumerate(cells):
             cell = f"chaos/{fault}/{pol_name}"
-            if cell in done:
+            if loop.skip(cell):
                 continue
             cell_rate = day_rate if fault in _DAY_CLASSES else rate
             spec = resil.FaultSpec.single(fault, rate=cell_rate,
@@ -263,27 +339,12 @@ def run_chaos(*, shape=(6, 48, 16), window: int = 8,
                       **degrade}
             rep.record(cell, kind="degrade", **result)
             rep.add_counters(cell, out.counters)
-            done[cell] = result
             progress(f"{cell}: {'ok' if result['ok'] else 'FAIL'} "
                      f"(events={degrade['degrade_events']}, "
                      f"watchdog={verdict['first_bad_stage']})")
-            if ck is not None:
-                ck.maybe_save(
-                    idx, {"done": {k: json.dumps(v, sort_keys=True)
-                                   for k, v in done.items()},
-                          "report_rows": [json.dumps(r, sort_keys=True,
-                                                     default=str)
-                                          for r in rep.rows[mark:]]},
-                    meta=ck_meta)
-                if die_after is not None and idx == int(die_after):
-                    progress(f"chaos: dying after cell {idx} "
-                             f"({_DIE_ENV} test hook)")
-                    os._exit(137)
+            loop.complete(idx, cell, result)
 
-    failures = {k: v for k, v in done.items() if not v["ok"]}
-    return {"ok": not failures, "cells": len(cells),
-            "failed": sorted(failures),
-            "results": {k: done[k] for k in sorted(done)}}
+    return loop.verdict(cells)
 
 
 # ------------------------------------------------------ the serving preset
@@ -362,32 +423,24 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
 
     rep = report if report is not None else obs.RunReport("chaos-serving")
     cells = [(fk, pk) for fk in faults for pk in policies]
-    done: dict = {}
-    ck = None
     ck_meta = {"entry": "chaos-serving",
                "config": [list(shape), window, method, faults, policies,
                           int(n_requests), float(load_factor), int(seed),
                           float(tol)]}
     with rep.activate():
-        # resume replacement slices from here, exactly like run_chaos: a
-        # resumed run's report must CONTINUE the killed run's rows (the
+        # resume replacement slices from the mark, exactly like run_chaos:
+        # a resumed run's report must CONTINUE the killed run's rows (the
         # skipped cells' serving rows come from the snapshot, so a
         # --report artifact never loses pre-kill cells), while rows a
         # caller recorded before us stay put
-        mark = len(rep.rows)
-        if checkpoint_path is not None:
-            ck = resil.Checkpointer(checkpoint_path, every=checkpoint_every)
-            got = ck.resume(expect_meta=ck_meta)
-            if got is not None:
-                state, _ = got
-                done = {k: json.loads(v) for k, v in state["done"].items()}
-                rep.rows[mark:] = [json.loads(row)
-                                   for row in state.get("report_rows", [])]
-                progress(f"chaos-serving: resumed {len(done)}/{len(cells)} "
-                         f"cells from {checkpoint_path}")
+        loop = CellLoop(rep, label="chaos-serving", n_cells=len(cells),
+                        mark=len(rep.rows), ck_meta=ck_meta,
+                        checkpoint_path=checkpoint_path,
+                        checkpoint_every=checkpoint_every,
+                        progress=progress)
         for idx, (fault, pol_name) in enumerate(cells):
             cell = f"serving/{fault}/{pol_name}"
-            if cell in done:
+            if loop.skip(cell):
                 continue
             server = TenantServer(names=names, pad_ladder=ladder, **panels)
             arrivals = bursty_arrivals(n_requests, rate_hz=rate_hz,
@@ -447,26 +500,159 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                           "retry_count", "rung_downgrades", "stale_served",
                           "cheap_fallbacks", "dispatches")}}
             rep.record(cell, kind="serving", **result)
-            done[cell] = result
             progress(f"{cell}: {'ok' if result['ok'] else 'FAIL'} "
                      f"(served={c['served']} shed={c['shed_count']} "
                      f"miss={c['deadline_miss_count']} "
                      f"failed={c['failed_count']} "
                      f"retries={c['retry_count']})")
-            if ck is not None:
-                ck.maybe_save(
-                    idx,
-                    {"done": {k: json.dumps(v, sort_keys=True)
-                              for k, v in done.items()},
-                     "report_rows": [json.dumps(r, sort_keys=True,
-                                                default=str)
-                                     for r in rep.rows[mark:]]},
-                    meta=ck_meta)
+            loop.complete(idx, cell, result)
 
-    failures = {k: v for k, v in done.items() if not v["ok"]}
-    return {"ok": not failures, "cells": len(cells),
-            "failed": sorted(failures),
-            "results": {k: done[k] for k in sorted(done)}}
+    return loop.verdict(cells)
+
+
+# ---------------------------------------------------- the scenarios preset
+
+#: scenario families of the --scenarios acceptance grid (the round-16
+#: scenario engine, factormodeling_tpu.scenarios) and the degrade-policy
+#: presets they cross with (build_policies — same four as the matrix).
+SCENARIO_FAMILIES = ("bootstrap", "regime", "adversarial")
+
+
+def _scenario_spec(scenarios, family: str, seed: int, d: int):
+    """The grid's per-family stress spec (aggressive but survivable:
+    every cell — default policy included — must hold the production
+    invariants; the sustained adversarial window keeps ``collapse_keep``
+    at the PR 7 value 1, where a collapsed date goes flat instead of
+    stacking carried books over the recovery gap — architecture §22)."""
+    if family == "bootstrap":
+        return scenarios.BootstrapSpec.make(seed=seed,
+                                            block_len=max(d // 5, 2))
+    if family == "regime":
+        return scenarios.RegimeSpec.make(seed=seed, vol_scale=3.0,
+                                         mean_shift=-0.01,
+                                         corr_tighten=0.6)
+    if family == "adversarial":
+        return scenarios.AdversarialSpec.make(
+            seed=seed, window_len=max(d // 3, 4), nan_rate=0.15,
+            inf_rate=0.05, outlier_rate=0.05, stale_rate=0.2,
+            drop_rate=0.25, collapse_rate=0.3, collapse_keep=1)
+    raise ValueError(f"unknown scenario family {family!r}; valid: "
+                     f"{SCENARIO_FAMILIES}")
+
+
+def run_scenario_chaos(*, shape=(6, 48, 16), window: int = 8,
+                       method: str = "equal", families=None, policies=None,
+                       n_paths: int = 6, seed: int = 0, tol: float = 0.05,
+                       report=None, checkpoint_path=None,
+                       checkpoint_every: int = 1, progress=print) -> dict:
+    """The round-16 SCENARIO grid: scenario family x degrade policy, each
+    cell a :func:`factormodeling_tpu.scenarios.run_scenarios` sweep of
+    ``n_paths`` stressed markets through one tenant config. Every cell
+    must produce FINITE risk rows (VaR/ES/drawdown — the ``kind=
+    "scenario"`` rows land on the report) and hold the chaos invariants
+    on every path's served book. Returns the same JSON-ready verdict
+    shape as :func:`run_chaos`; importable for the tier-1 smoke."""
+    import numpy as np
+
+    from factormodeling_tpu import obs, resil, scenarios
+    from factormodeling_tpu.serve import TenantConfig
+
+    f, d, n = shape
+    names, args = make_inputs(f, d, n, seed=seed)
+    panels = dict(zip(("factors", "returns", "factor_ret", "cap_flag",
+                       "investability", "universe"), args))
+    families = list(families or SCENARIO_FAMILIES)
+    unknown = set(families) - set(SCENARIO_FAMILIES)
+    if unknown:
+        raise ValueError(f"unknown scenario families {sorted(unknown)}; "
+                         f"valid: {SCENARIO_FAMILIES}")
+    template = TenantConfig(top_k=max(f // 2, 1), icir_threshold=-1.0,
+                            method=method, window=window, max_weight=0.5,
+                            pct=0.25, lookback_period=min(8, d))
+
+    rep = report if report is not None else obs.RunReport("chaos-scenarios")
+    with rep.activate():
+        mark = len(rep.rows)
+        # clean probe: one identity-regime path (bit-equal to the base
+        # market) keys the clamp policy's threshold to the healthy
+        # composite absmax, the build_policies contract
+        with rep.span("scenarios/baseline") as sp:
+            clean = scenarios.run_scenarios(
+                names=names, template=template,
+                spec=scenarios.RegimeSpec.off(seed=seed), n_paths=1,
+                chunk=1, return_books=True, **panels)
+            sp.add(clean.books.signal)
+        blend_absmax = float(np.nanmax(np.abs(
+            np.asarray(clean.books.signal))))
+        all_policies = build_policies(resil, blend_absmax)
+        policies = list(policies or all_policies)
+        unknown = set(policies) - set(all_policies)
+        if unknown:
+            raise ValueError(f"unknown policies {sorted(unknown)}; valid: "
+                             f"{sorted(all_policies)}")
+
+        cells = [(fam, pk) for fam in families for pk in policies]
+        ck_meta = {"entry": "chaos-scenarios",
+                   "config": [list(shape), window, method, families,
+                              policies, int(n_paths), int(seed),
+                              float(tol)]}
+        loop = CellLoop(rep, label="chaos-scenarios", n_cells=len(cells),
+                        mark=mark, ck_meta=ck_meta,
+                        checkpoint_path=checkpoint_path,
+                        checkpoint_every=checkpoint_every,
+                        progress=progress, die_env=_DIE_ENV)
+        # one runner per family: every policy cell of a family reuses the
+        # SAME compiled executable (spec/policy are traced pytrees — the
+        # PR 7 one-compile-serves-the-matrix discipline)
+        runners: dict = {}
+        for idx, (family, pol_name) in enumerate(cells):
+            cell = f"scenario/{family}/{pol_name}"
+            if loop.skip(cell):
+                continue
+            # seed from the cell IDENTITY, not the enumeration index:
+            # report_diff gates kind="scenario" rows by NAME across runs,
+            # and a position-derived seed would redraw a cell's paths
+            # whenever --faults/--policies changes the grid composition —
+            # a spurious (or masked) risk regression from cell order
+            cell_seed = seed + zlib.crc32(cell.encode()) % 100003
+            spec = _scenario_spec(scenarios, family, cell_seed, d)
+            if family not in runners:
+                runners[family] = scenarios.make_scenario_runner(
+                    names=names, template=template, family=family,
+                    return_books=True)
+            res = scenarios.run_scenarios(
+                names=names, template=template, spec=spec,
+                policy=all_policies[pol_name], n_paths=n_paths,
+                chunk=n_paths, return_books=True, report=rep, tag=cell,
+                runner=runners[family], **panels)
+            violations: list[str] = []
+            if not res.finite_ok:
+                violations.append(
+                    f"non-finite path metrics: {res.nonfinite}")
+            for row in res.rows:
+                bad = [v for v in row["var"] + row["es"]
+                       if not np.isfinite(v)]
+                if bad:
+                    violations.append(
+                        f"{row['metric']}: non-finite VaR/ES {bad}")
+            for p in range(n_paths):
+                path_bad = check_invariants(res.book(p), tol=tol)
+                violations.extend(f"path {p}: {msg}" for msg in path_bad)
+                if len(violations) >= 8:
+                    break
+            result = {"family": family, "policy": pol_name,
+                      "ok": not violations, "violations": violations,
+                      "paths": int(n_paths),
+                      # per-PATH failure count: a broken path counts once,
+                      # however many of its metrics went non-finite
+                      "nonfinite_paths": res.nonfinite_path_count,
+                      **{k: int(v) for k, v in sorted(res.degrade.items())}}
+            rep.record(cell, kind="scenario_cell", **result)
+            progress(f"{cell}: {'ok' if result['ok'] else 'FAIL'} "
+                     f"(paths={n_paths}, degrade={res.degrade})")
+            loop.complete(idx, cell, result)
+
+    return loop.verdict(cells)
 
 
 def main(argv=None) -> int:
@@ -506,7 +692,20 @@ def main(argv=None) -> int:
     parser.add_argument("--load", type=float, default=1.5,
                         help="arrival rate as a multiple of queue "
                              "capacity (with --serving)")
+    parser.add_argument("--scenarios", action="store_true",
+                        help="run the SCENARIO preset: scenario family x "
+                             "degrade-policy cells, each a vmapped "
+                             "stressed-market sweep with risk rows "
+                             "(module docs). --faults selects families "
+                             "(bootstrap/regime/adversarial), --policies "
+                             "the matrix presets")
+    parser.add_argument("--paths", type=int, default=6,
+                        help="scenario paths per cell (with --scenarios)")
     args = parser.parse_args(argv)
+    if args.serving and args.scenarios:
+        print("chaos: --serving and --scenarios are mutually exclusive",
+              file=sys.stderr)
+        return 2
 
     try:
         shape = tuple(int(v) for v in args.shape.split(","))
@@ -526,13 +725,22 @@ def main(argv=None) -> int:
 
     from factormodeling_tpu import obs
 
-    rep = obs.RunReport("chaos-serving" if args.serving else "chaos")
+    rep = obs.RunReport("chaos-scenarios" if args.scenarios
+                        else "chaos-serving" if args.serving else "chaos")
     faults = None if args.faults == "all" else args.faults.split(",")
     policies = None if args.policies == "all" else args.policies.split(",")
     from factormodeling_tpu.resil import SnapshotCorrupt
 
     try:
-        if args.serving:
+        if args.scenarios:
+            verdict = run_scenario_chaos(
+                shape=shape, window=args.window, method=args.method,
+                families=faults, policies=policies, n_paths=args.paths,
+                seed=args.seed, tol=args.tol, report=rep,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                progress=lambda msg: print(msg, file=sys.stderr))
+        elif args.serving:
             verdict = run_serving_chaos(
                 shape=shape, window=args.window, method=args.method,
                 faults=faults, policies=policies,

@@ -240,7 +240,12 @@ def default_targets(repo_root=None) -> list[Path]:
     same globs: serve/queue.py's whole claim is that scheduling time is
     VIRTUAL (an ambient perf_counter read there would re-couple verdict
     logs to host jitter), and resil/retry.py owns the backoff sleeps a
-    careless wall-clock window would sit right next to."""
+    careless wall-clock window would sit right next to. The scenario
+    engine (round 16) joins by its own glob: the chunked host sweep
+    loop is exactly the shape where an ad-hoc paths/s window would be
+    tempting and wrong (the vmapped dispatch returns before a single
+    path has computed — the bench's fenced harness is the only sound
+    way to time it), pinned by name in tests/test_lint_timing.py."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))
@@ -249,6 +254,7 @@ def default_targets(repo_root=None) -> list[Path]:
             + sorted((pkg / "obs").glob("*.py"))
             + sorted((pkg / "ops").glob("_pallas_*.py"))
             + sorted((pkg / "resil").glob("*.py"))
+            + sorted((pkg / "scenarios").glob("*.py"))
             + sorted((pkg / "serve").glob("*.py"))
             + sorted((pkg / "solvers").glob("*.py")))
 

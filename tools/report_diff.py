@@ -19,8 +19,14 @@ operand), a latency-sketch p50/p99 beyond the wall ratio, a violated
 ``SLOSpec`` budget (gated even under ``--no-wall`` — the budget is the
 run's own declaration, not a machine comparison), a serving queue that
 shed / missed / retried more requests than the baseline under the same
-traffic (``kind="serving"`` rows, round 15), or a seconds-valued
+traffic (``kind="serving"`` rows, round 15), a scenario risk row whose
+VaR/ES worsened beyond the ratio + the baseline's recorded spread or
+went non-finite (``kind="scenario"`` rows, round 16 — gated even under
+``--no-wall``: scenario sweeps are seeded-deterministic, a risk
+worsening is never machine speed), or a seconds-valued
 bench row beyond the ratio AND the baseline's recorded best-of-N spread
+— throughput rows with ANY ``/s`` unit (``configs/s``, ``paths/s``)
+gate on drops through the same clause —
 all exit 1 with a one-line attribution. Reports with mismatched
 ``kind="meta"`` schema versions REFUSE to gate; cross-backend pairs warn
 and skip wall gating automatically.
@@ -101,6 +107,11 @@ def main(argv=None) -> int:
     parser.add_argument("--mem-min-bytes", type=float, default=float(1 << 20),
                         help="absolute peak-byte growth below this never "
                              "gates (default 1 MiB)")
+    parser.add_argument("--risk-floor", type=float, default=0.05,
+                        help="absolute VaR/ES worsening floor for "
+                             "scenario rows with tiny/negative baselines "
+                             "(default 0.05; the ratio gate covers "
+                             "well-sized risks)")
     parser.add_argument("--json", action="store_true",
                         help="emit the findings as one JSON object instead "
                              "of text")
@@ -133,7 +144,7 @@ def main(argv=None) -> int:
         check_wall=not args.no_wall, counter_tol=args.counter_tol,
         finite_tol=args.finite_tol, comms_ratio=args.comms_ratio,
         comms_min_bytes=args.comms_min_bytes, mem_ratio=args.mem_ratio,
-        mem_min_bytes=args.mem_min_bytes)
+        mem_min_bytes=args.mem_min_bytes, risk_floor=args.risk_floor)
 
     if args.json:
         print(json.dumps({

@@ -15,7 +15,8 @@ records print in their own sections. Pure stdlib — usable on any box that has 
 required.
 
 Exit codes: 0 = rendered (``--strict`` turns unsound spans, sharding-lint
-flags, SLO violations, and malformed latency/devtime/serving rows — a
+flags, SLO violations, and malformed latency/devtime/serving/scenario
+rows (a scenario risk row with non-finite VaR/ES fails strict) — a
 serving row whose verdict counts do not sum to its submissions — into 1);
 2 = unusable input (missing/unreadable file, or no parseable rows at all
 — empty or fully corrupt). A truncated tail — a run killed mid-write — is
@@ -416,13 +417,40 @@ def _serving_table(rows) -> str | None:
                           "extra"), body))
 
 
+def _scenario_table(rows) -> str | None:
+    sc = [r for r in rows if r.get("kind") == "scenario"]
+    if not sc:
+        return None
+    last: dict[str, dict] = {}
+    for r in sc:
+        last[r.get("name", "?")] = r
+
+    def fmt_vec(r, key):
+        levels = r.get("levels") or []
+        vals = r.get(key) or []
+        return " ".join(f"{lv:g}:{_num(v)}" for lv, v in zip(levels, vals)) \
+            or "-"
+
+    body = []
+    for name, r in sorted(last.items()):
+        body.append((name, r.get("metric", "?"),
+                     r.get("paths", "-"),
+                     fmt_vec(r, "var"), fmt_vec(r, "es"),
+                     f"{_num(r.get('p50', '-'))}/{_num(r.get('p99', '-'))}",
+                     r.get("nonfinite_paths", "-")))
+    return ("== scenario risk (VaR/ES oriented bigger-is-worse; "
+            "sketch-backed, re-mergeable from the row) ==\n"
+            + _fmt_table(("sweep/metric", "metric", "paths", "VaR@level",
+                          "ES@level", "p50/p99", "nonfinite"), body))
+
+
 def _stage_table(rows) -> str | None:
     stages = [r for r in rows
               if r.get("kind") not in ("span", "counters", "cost", "bench",
                                        "numerics", "watchdog", "compile",
                                        "comms", "memory", "sharding",
                                        "latency", "devtime", "serving",
-                                       "meta")]
+                                       "scenario", "meta")]
     if not stages:
         return None
     body = []
@@ -467,10 +495,10 @@ def render(rows) -> str:
              "device_count", "mesh_shape") if meta.get(k) is not None))
     sections = [head]
     for maker in (_span_table, _latency_table, _serving_table,
-                  _counter_table, _solver_table, _numerics_table,
-                  _watchdog_table, _compile_table, _comms_table,
-                  _memory_table, _sharding_table, _devtime_table,
-                  _cost_table, _bench_table, _stage_table):
+                  _scenario_table, _counter_table, _solver_table,
+                  _numerics_table, _watchdog_table, _compile_table,
+                  _comms_table, _memory_table, _sharding_table,
+                  _devtime_table, _cost_table, _bench_table, _stage_table):
         section = maker(rows)
         if section:
             sections.append(section)
@@ -509,17 +537,43 @@ def slo_violations(rows) -> list[str]:
 
 
 def malformed_rows(rows) -> list[str]:
-    """Descriptions of latency/devtime/serving rows missing their
-    contract fields — strict validation of the PR 9/15 row kinds. A
-    latency row must carry a count and (when non-empty) finite p50/p99; a
-    devtime row must carry device seconds OR an honest skip/error reason;
-    a serving row must carry non-negative integer verdict counts that SUM
-    to its submissions — the queue's completeness contract, judged from
-    the artifact alone."""
+    """Descriptions of latency/devtime/serving/scenario rows missing
+    their contract fields — strict validation of the PR 9/15/16 row
+    kinds. A latency row must carry a count and (when non-empty) finite
+    p50/p99; a devtime row must carry device seconds OR an honest
+    skip/error reason; a serving row must carry non-negative integer
+    verdict counts that SUM to its submissions — the queue's completeness
+    contract, judged from the artifact alone; a scenario risk row with
+    folded paths must carry FINITE VaR/ES at every level (a NaN/Inf risk
+    number is a broken sweep, never a publishable tail)."""
     bad = []
     for r in rows:
         kind = r.get("kind")
-        if kind == "serving":
+        if kind == "scenario":
+            name = r.get("name", "?")
+            paths = r.get("paths")
+            if not isinstance(paths, int) or isinstance(paths, bool) \
+                    or paths < 0:
+                bad.append(f"scenario row {name!r}: missing/invalid "
+                           f"paths {paths!r}")
+                continue
+            if paths == 0:
+                continue  # an empty sweep has nothing to judge
+            levels = r.get("levels") or []
+            for key in ("var", "es"):
+                vals = r.get(key)
+                if not isinstance(vals, list) or len(vals) != len(levels):
+                    bad.append(f"scenario row {name!r}: {key} missing or "
+                               f"not matching levels {levels}")
+                    continue
+                broken = [v for v in vals
+                          if not isinstance(v, (int, float))
+                          or isinstance(v, bool)
+                          or not math.isfinite(float(v))]
+                if broken:
+                    bad.append(f"scenario row {name!r}: non-finite "
+                               f"{key.upper()} value(s) {broken}")
+        elif kind == "serving":
             name = r.get("name", "?")
             vals = {k: r.get(k) for k in _SERVING_INT_KEYS}
             broken = [k for k, v in vals.items()
@@ -566,7 +620,8 @@ def main(argv=None) -> int:
                              "declared host-synchronous window), any "
                              "sharding-lint row is flagged, any latency "
                              "SLO is violated, or any latency/devtime/"
-                             "serving row is malformed — makes the "
+                             "serving/scenario row is malformed (incl. "
+                             "non-finite VaR/ES) — makes the "
                              "renderer CI-able")
     args = parser.parse_args(argv)
     try:
@@ -602,7 +657,7 @@ def main(argv=None) -> int:
         malformed = malformed_rows(rows)
         if malformed:
             print(f"strict: {len(malformed)} malformed latency/devtime/"
-                  f"serving row(s): " + "; ".join(malformed),
+                  f"serving/scenario row(s): " + "; ".join(malformed),
                   file=sys.stderr)
             rc = 1
         return rc
