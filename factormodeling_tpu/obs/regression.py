@@ -86,6 +86,21 @@ flags):
   ``nonfinite_paths`` growth are regressions outright (a path whose risk
   scalar isn't a number is a broken scenario, not a tail event);
   improvements and brand-new scenario rows are notes.
+- **online** (advance-engine rows, round 17) — every baseline
+  ``kind="online"`` row must still exist; its ``rejected_dates`` /
+  ``replayed_dates`` / ``full_recompute_fallbacks`` gate UP (under the
+  same recorded feed, more rejections or replays means the feed — or the
+  engine's guards — got worse; the fallback count is an O(history)
+  recompute a healthy stream never takes), and a NEW report whose
+  verdict counts do not sum to its ingestions is a regression outright
+  (the engine's completeness invariant, judged from the artifact). Both
+  stay armed under ``--no-wall`` — verdict counts are never machine
+  speed. The per-date advance latency scopes (``online/*`` and
+  ``bench/online_advance``) additionally keep their p50/p99 ratio gate
+  armed under ``--no-wall`` at the count-aware floor: the advance p99 is
+  the product's own SLO surface, so a worsening must not hide behind a
+  cross-machine diff (the finding is labeled so a genuinely cross-backend
+  pair can be triaged).
 
 Deliberately **pure stdlib** with no package-relative imports:
 ``tools/report_diff.py`` loads this file standalone (importlib by path) so
@@ -104,8 +119,13 @@ from pathlib import Path
 __all__ = ["DiffResult", "Finding", "GATE_UP", "bench_rows", "comms_rows",
            "counter_scalars", "devtime_rows", "diff_reports",
            "latency_rows", "load_jsonl", "memory_rows", "meta_row",
-           "numerics_baseline", "scenario_rows", "serving_rows",
-           "sharding_rows", "span_totals"]
+           "numerics_baseline", "online_rows", "scenario_rows",
+           "serving_rows", "sharding_rows", "span_totals"]
+
+#: online-engine counters whose INCREASE against a baseline is a
+#: regression (kind="online" rows; see the module docs' online section)
+ONLINE_GATE_UP = ("rejected_dates", "replayed_dates",
+                  "full_recompute_fallbacks")
 
 #: counter keys whose INCREASE is a regression (everything else drifts
 #: informationally). Nested mean/max counters gate on their "mean" leaf.
@@ -292,6 +312,28 @@ def scenario_rows(rows) -> dict:
     rows (kind="scenario_cell") are not risk rows and are excluded."""
     return {r.get("name", ""): r for r in rows
             if r.get("kind") == "scenario"}
+
+
+def online_rows(rows) -> dict:
+    """name -> last online-engine row (kind="online"); last wins — the
+    engine re-emits its counters after every verdict, and the final row
+    carries the stream's terminal tallies."""
+    return {r.get("name", "?"): r for r in rows
+            if r.get("kind") == "online"}
+
+
+def online_verdicts_complete(row) -> bool:
+    """The engine's completeness invariant, judged from one row."""
+    def n(key):
+        v = row.get(key)
+        return v if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+
+    parts = [n("applied_dates"), n("replayed_dates"), n("rejected_dates")]
+    total = n("ingested_dates")
+    if total is None or any(p is None for p in parts):
+        return False
+    return sum(parts) == total
 
 
 def bench_rows(rows) -> dict:
@@ -575,7 +617,13 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
                 "latency", name, "latency row present in baseline, "
                 "missing in new report", regression=True))
             continue
-        if not check_wall:
+        # the online-advance scopes stay armed under --no-wall: the
+        # advance p99 is the product's own SLO surface (module docs'
+        # online section), so its worsening must not hide behind a
+        # cross-machine diff
+        online_scope = (name.startswith("online/")
+                        or name == "bench/online_advance")
+        if not check_wall and not online_scope:
             continue
         # the span floor exists because a SINGLE-SHOT tiny wall is mostly
         # scheduler noise — but a quantile backed by many observations is
@@ -591,10 +639,12 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
                 continue
             ratio = n / b if b > 0 else float("inf")
             if ratio > wall_ratio:
+                armed = (" — online advance scope, armed under --no-wall"
+                         if not check_wall else "")
                 findings.append(Finding(
                     "latency", f"{name}/{label}",
                     f"{label} {b:.6g}s -> {n:.6g}s ({ratio:.2f}x > "
-                    f"{wall_ratio:g}x tolerance)", regression=True))
+                    f"{wall_ratio:g}x tolerance){armed}", regression=True))
     for name in sorted(set(new_lat) - set(base_lat)):
         findings.append(Finding(
             "latency", name, "latency scope absent from baseline (new "
@@ -723,6 +773,41 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
         findings.append(Finding(
             "scenario", name, "scenario risk row absent from baseline "
             "(new sweep) — re-baseline to gate it"))
+
+    # ---- online-engine rows: verdict-count growth gates UP, completeness
+    # gates outright. Verdict counts are never machine speed, so — like
+    # the scenario gate — this section stays armed under --no-wall.
+    base_on, new_on = online_rows(base_rows), online_rows(new_rows)
+    for name, base_row in sorted(base_on.items()):
+        new_row = new_on.get(name)
+        if new_row is None:
+            findings.append(Finding(
+                "online", name, "online-engine row present in baseline, "
+                "missing in new report", regression=True))
+            continue
+        for key in ONLINE_GATE_UP:
+            b, nv = base_row.get(key), new_row.get(key)
+            if not isinstance(b, (int, float)) \
+                    or not isinstance(nv, (int, float)) or nv == b:
+                continue
+            findings.append(Finding(
+                "online", f"{name}/{key}",
+                f"{b:g} -> {nv:g} (delta {nv - b:+g})",
+                regression=nv > b))
+    for name, new_row in sorted(new_on.items()):
+        if not online_verdicts_complete(new_row):
+            findings.append(Finding(
+                "online", f"{name}/completeness",
+                f"verdict counts do not sum to ingestions "
+                f"(applied {new_row.get('applied_dates')} + replayed "
+                f"{new_row.get('replayed_dates')} + rejected "
+                f"{new_row.get('rejected_dates')} != ingested "
+                f"{new_row.get('ingested_dates')}) — a date terminated "
+                f"in zero or two verdicts", regression=True))
+        if name not in base_on:
+            findings.append(Finding(
+                "online", name, "online-engine row absent from baseline "
+                "(new stream) — re-baseline to gate it"))
 
     # ---- bench rows: seconds-valued rows gate at wall_ratio against the
     # spread-aware baseline; presence never gates (configs are selected

@@ -1,0 +1,56 @@
+"""Incremental online advance: the exactly-once multi-tenant state machine.
+
+Layers (each its own module, lazily reachable — importing the package
+costs only the state/advance definitions; the default offline research
+step never imports any of this, pinned by the elision test in
+``tests/test_online.py``):
+
+- :mod:`~factormodeling_tpu.online.state` — the O(window) carry pytrees
+  (:class:`MarketState` / :class:`TenantState`) and the
+  :class:`DateSlice` ingestion unit;
+- :mod:`~factormodeling_tpu.online.advance` — the per-date advance,
+  bit-for-bit equal to the full-recompute research step (differential
+  ladder in ``tests/test_online.py``; honest limits in its module docs);
+- :mod:`~factormodeling_tpu.online.engine` — the host-side robustness
+  loop: every ingested date terminates in exactly one of APPLIED |
+  REPLAYED | REJECTED, restatements roll back and replay from a bounded
+  snapshot ring (beyond-horizon = counted full-recompute fallback), and
+  state checkpoints through ``resil.checkpoint`` under a fingerprint
+  guard so a SIGKILL'd engine resumes with no double-applied and no lost
+  date.
+
+The many-tenant fan-out lives on the serving layer:
+``TenantServer.online_begin`` / ``TenantServer.advance_all`` advance
+every tenant of a signature bucket in ONE vmapped dispatch over the
+stacked state pytrees (``serve/frontend.py``).
+"""
+
+from factormodeling_tpu.online.advance import (
+    OnlineCtx,
+    make_online_step,
+    online_step_parts,
+)
+from factormodeling_tpu.online.engine import (
+    EngineGuards,
+    OnlineEngine,
+    OnlineVerdict,
+)
+from factormodeling_tpu.online.state import (
+    AdvanceOutputs,
+    DateSlice,
+    MarketState,
+    TenantState,
+)
+
+__all__ = [
+    "AdvanceOutputs",
+    "DateSlice",
+    "EngineGuards",
+    "MarketState",
+    "OnlineCtx",
+    "OnlineEngine",
+    "OnlineVerdict",
+    "TenantState",
+    "make_online_step",
+    "online_step_parts",
+]

@@ -1,0 +1,509 @@
+"""The exactly-incremental research step: O(window) per arriving date.
+
+``online_step_parts`` builds the two halves of a per-date advance, split
+along the serving layer's hoist line (``serve/batched.py``):
+
+- ``advance_market(mstate, date_slice)`` — config-independent: push the
+  date into the raw tail rings, compute THAT date's
+  :func:`~factormodeling_tpu.metrics.daily_factor_stats` on the tail
+  slice (one [F, T, N] pass — T is ``stats_tail``, not history), push the
+  stat columns and the factor-return row into the window rings, rebuild
+  the ring-shaped :class:`~factormodeling_tpu.selection.selectors.
+  SelectionContext`, and (under ``covariance="risk_model"``) refit the
+  rolling risk model on its refit grid. Runs ONCE per bucket per date.
+- ``advance_tenant(tenant, tstate, octx)`` — everything downstream of a
+  tenant leaf: selector -> manager mix -> finalize -> single-date blend
+  -> the day's weight solve (reusing ``backtest.mvo._solve_day``, the
+  single source of the ladder semantics) -> per-symbol masked weight
+  shift -> single-date P&L. This is the half ``TenantServer.advance_all``
+  vmaps over a stacked config/state batch.
+
+Bit-for-bit contract (pinned by the differential ladder in
+``tests/test_online.py``): feeding dates 0..D-1 one at a time reproduces
+the full-recompute research step's rows 0..D-2 EXACTLY (f64) across
+equal/linear/mvo/mvo_turnover, NaN panels, and risk-model covariance.
+The mechanism is structural, not tolerance-based: every windowed
+aggregate is computed by the SAME primitives (``rolling_sum`` /
+``rolling_metrics`` / ``masked_shift`` / the selectors / the blend / the
+day solve) over a ring slice strictly longer than its window — XLA's
+``reduce_window`` output for a given position depends only on the window
+contents when the slice exceeds the window (verified bitwise; an
+exact-window-length slice is NOT safe, which is why every ring carries
+margin) — and ramp-up padding is NaN/False, whose contribution to every
+NaN-aware reducer is IEEE-exactly the recompute's edge padding (adding
+0.0 is exact).
+
+Honest limits of the contract, each the ring-horizon trade the O(window)
+claim buys (docs/architecture.md §23):
+
+- ragged-universe exposure shifts hop gaps; a per-symbol gap longer than
+  ``stats_tail - shift_periods - 1`` reaches past the tail ring (the full
+  recompute would find the old value, the online step sees NaN);
+- NaN-thinned suffix POOLS in the weighted blend expose a quantile
+  boundary coincidence: when a pooled quantile position ``q * (cnt - 1)``
+  is integral in real arithmetic but not in floats, the interpolated
+  threshold lands within one ulp of an actual pool value and the
+  ``_eq``-family comparisons (``vals >= hi``) flip with the compiling
+  program's FMA contraction choices. This is a property of the OFFLINE
+  blend across any two compiled shapes — ``composite_weighted`` compiled
+  at ``[F, 1, N]`` vs ``[F, D, N]`` flips the same cells on the same
+  inputs (measured ~5/27 dates at 15% factor NaN; demonstrated in
+  ``tests/test_online.py``) — so differential cases with NaN-thinned
+  pools pin at fixed seeds, exactly like the repo's other bit-level
+  goldens;
+- total history must reach ``lookback_period`` (sample covariance),
+  ``risk_lookback`` (risk model), and ``mvo_batch`` (the plain-MVO warm
+  chain) — shorter FULL panels make the recompute itself clamp those
+  statics to the panel length, a program the online rings (sized to the
+  steady state) do not trace;
+- ``mvo_turnover`` advances with the sequential-scan semantics
+  (``turnover_mode="scan"`` — the reference semantics); a tenant
+  requesting ``"parallel"`` is served the scan-equivalent stream (the
+  parallel scheme's own differential pins the two agree);
+- the research-step STATE EVOLUTION and panel rows — selection, signal,
+  traded weights, leg counts, solver residual/acceptance — are the
+  bit-for-bit surface. The per-date P&L SCALARS are ulp-exact instead:
+  a product-reduce's accumulation order is an XLA fusion decision, so
+  the same row summed inside two different compiled programs can differ
+  in the last bit (measured: ~10/27 days at 1 ulp on the linear scheme).
+  The bitwise P&L statement is therefore compositional — the online
+  traded books are bit-identical, and ``backtest.pnl.
+  daily_portfolio_returns`` over the stacked online books reproduces the
+  recompute's ``DailyResult`` bit-for-bit (same kernel, same shapes) —
+  which the differential ladder pins alongside the direct row equality.
+  The per-name cumulative accumulators additionally run in stream order,
+  not the recompute's tree-reduction order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from factormodeling_tpu.backtest.mvo import _solve_day
+from factormodeling_tpu.backtest.settings import SimulationSettings
+from factormodeling_tpu.backtest.weights import equal_weights, leg_masks, linear_weights
+from factormodeling_tpu.composite import composite_weighted
+from factormodeling_tpu.metrics import daily_factor_stats, rolling_metrics
+from factormodeling_tpu.obs.trace import stage as obs_stage
+from factormodeling_tpu.online.state import (
+    AdvanceOutputs,
+    DateSlice,
+    MarketState,
+    TenantState,
+    init_market_state,
+    init_tenant_state,
+)
+from factormodeling_tpu.ops._window import rolling_sum, shift
+from factormodeling_tpu.selection import selection_metric_needs
+from factormodeling_tpu.selection.selectors import (
+    FACTOR_SELECTION_METHODS,
+    SelectionContext,
+)
+from factormodeling_tpu.serve.tenant import TenantConfig
+
+__all__ = ["OnlineCtx", "online_step_parts", "make_online_step"]
+
+#: exposure lag of the selection path (the reference shifts twice:
+#: FactorSelector.__init__ + single_factor_metrics)
+_SHIFT = 2
+
+
+class OnlineCtx(NamedTuple):
+    """The market half's product, consumed by every tenant of the bucket
+    (an unbatched closure under ``advance_all``'s config vmap — the hoist
+    discipline of ``serve/batched.py``)."""
+
+    ctx: SelectionContext       # ring-shaped selection context
+    p: jnp.ndarray              # int32[] the date being finalized (day-1)
+    ready: jnp.ndarray          # bool[] p >= 0
+    factors_p: jnp.ndarray      # [F, N] exposures at p
+    returns_p: jnp.ndarray      # [N]
+    cap_p: jnp.ndarray          # [N]
+    invest_p: jnp.ndarray       # [N]
+    universe_p: Any             # bool[N] or None
+    lb_ring: Any                # [LB, N] left-aligned returns <= p-1, or None
+    risk_model: Any             # day-p (loadings, fvar, idio, hist) or None
+
+
+def _push(tail: jnp.ndarray, row: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Roll the date axis left one slot and write ``row`` at the end."""
+    axis = axis % tail.ndim
+    idx = [slice(None)] * tail.ndim
+    idx[axis] = slice(1, None)
+    return jnp.concatenate([tail[tuple(idx)],
+                            jnp.expand_dims(row, axis)], axis=axis)
+
+
+def _push_left(ring: jnp.ndarray, row: jnp.ndarray, n_filled) -> jnp.ndarray:
+    """Left-aligned append: while ramping, write at ``n_filled``; once
+    full, shift down and write at the top — positions ``0..min(n, cap)-1``
+    always hold the most recent rows in ascending date order, exactly the
+    layout ``_window_factors``' clamped ``dynamic_slice`` reads from a
+    full panel."""
+    cap = ring.shape[0]
+    shifted = jnp.where(n_filled >= cap,
+                        jnp.concatenate([ring[1:], ring[:1]], axis=0), ring)
+    idx = jnp.minimum(n_filled, cap - 1).astype(jnp.int32)
+    start = (idx,) + (jnp.zeros((), jnp.int32),) * (ring.ndim - 1)
+    return lax.dynamic_update_slice(shifted, row[None], start)
+
+
+def _probe_settings(template: TenantConfig) -> SimulationSettings:
+    """Host-side settings probe resolving the bucket's STATIC simulation
+    residue (mvo_batch, covariance/risk knobs, qp flags) exactly as the
+    full-recompute step would."""
+    z = np.zeros((1, 1))
+    return SimulationSettings(returns=z, cap_flag=z, investability_flag=z,
+                              method=template.method,
+                              lookback_period=template.lookback_period,
+                              **dict(template.sim_static))
+
+
+def online_step_parts(*, names, template: TenantConfig, n_assets: int,
+                      dtype=jnp.float64, has_universe: bool = False,
+                      stats_tail: int = 8):
+    """(init_market, init_tenant, advance_market, advance_tenant) for the
+    bucket ``template`` shapes (module docs). ``stats_tail`` bounds the
+    ragged-universe shift horizon of the daily-stats tail ring; raise it
+    for universes with long per-symbol gaps."""
+    names = tuple(names)
+    f = len(names)
+    n = int(n_assets)
+    window = int(template.window)
+    select_method = template.select_method
+    select_static = dict(template.select_static)
+    if select_method == "icir_top":
+        select_static["use_rank_icir"] = template.use_rank_icir
+    selector = FACTOR_SELECTION_METHODS.get(select_method)
+    if selector is None:
+        raise ValueError(f"Unknown factor selection method: {select_method}")
+    needs = tuple(selection_metric_needs(select_method, select_static))
+    probe = _probe_settings(template)
+    risk = probe.covariance == "risk_model"
+    lb = int(probe.risk_lookback if risk else probe.lookback_period)
+    tail = max(int(stats_tail), _SHIFT + 3)
+    ring = window + 3
+    q_p = ring - 2          # ring index of the finalized date p
+    method = template.method
+    warm_start = bool(probe.qp_warm_start)
+    mvo_batch = int(probe.mvo_batch)
+    needs_solver = method in ("mvo", "mvo_turnover")
+
+    def init_market() -> MarketState:
+        return init_market_state(
+            n_factors=f, n_assets=n, dtype=dtype, stats_needs=needs,
+            tail=tail, ring=ring, lb=(lb if needs_solver else None),
+            has_universe=has_universe,
+            risk_factors=(probe.risk_factors if risk and needs_solver
+                          else None))
+
+    def init_tenant() -> TenantState:
+        return init_tenant_state(
+            n_assets=n, dtype=dtype, method=method,
+            mvo_batch=(mvo_batch if method == "mvo" else None),
+            warm_start=warm_start)
+
+    # --------------------------------------------------- market half
+
+    def _refit_risk(lb_ring, p):
+        """Refit the rolling statistical risk model at day ``p`` on the
+        (at most ``risk_lookback``) rows strictly before it — the same
+        masked input ``backtest.mvo._risk_model_stack.fit_one`` builds
+        from the full panel, so the fit is bit-identical."""
+        from factormodeling_tpu import risk as _risk
+
+        n_used = jnp.minimum(p, lb).astype(dtype)
+        used = (jnp.arange(lb) < jnp.minimum(p, lb))[:, None]
+        m = _risk.statistical_risk_model(
+            jnp.where(used, lb_ring, jnp.nan), probe.risk_factors)
+        scale = (lb - 1.0) / jnp.maximum(n_used - 1.0, 1.0)
+        return m.loadings, m.factor_var * scale, m.idio_var
+
+    def advance_market(mstate: MarketState, d: DateSlice):
+        t = mstate.day + 1
+        p = t - 1
+        ready = p >= 0
+        with obs_stage("online/ingest"):
+            factors_tail = _push(mstate.factors_tail,
+                                 jnp.asarray(d.factors, dtype), axis=-2)
+            returns_tail = _push(mstate.returns_tail,
+                                 jnp.asarray(d.returns, dtype), axis=0)
+            cap_tail = _push(mstate.cap_tail,
+                             jnp.asarray(d.cap_flag, dtype), axis=0)
+            invest_tail = _push(mstate.invest_tail,
+                                jnp.asarray(d.investability, dtype), axis=0)
+            universe_tail = None
+            if has_universe:
+                universe_tail = _push(mstate.universe_tail,
+                                      jnp.asarray(d.universe, bool), axis=0)
+        stats_ring = mstate.stats_ring
+        if needs:
+            with obs_stage("online/daily_stats"):
+                daily = daily_factor_stats(factors_tail, returns_tail,
+                                           shift_periods=_SHIFT,
+                                           universe=universe_tail,
+                                           stats=needs)
+            stats_ring = {k: _push(stats_ring[k], daily[k][:, -1], axis=-1)
+                          for k in needs}
+        fr_ring = _push(mstate.fr_ring, jnp.asarray(d.factor_ret, dtype),
+                        axis=0)
+
+        # covariance rings lag by one finalization: solving date p reads
+        # returns <= p-1, so each advance pushes date t-2's row (already
+        # resident at tail position -3 after this advance's push)
+        lb_ring = mstate.lb_ring
+        if lb_ring is not None:
+            pushed = _push_left(lb_ring, returns_tail[-3],
+                                jnp.maximum(t - 2, 0))
+            lb_ring = jnp.where(t >= 2, pushed, lb_ring)
+
+        risk_model = mstate.risk_model
+        if risk_model is not None:
+            refit = ready & (p % probe.risk_refit_every == 0)
+            risk_model = lax.cond(
+                refit, lambda ring: _refit_risk(ring, p),
+                lambda ring: mstate.risk_model, lb_ring)
+
+        with obs_stage("online/context"):
+            rm = rolling_metrics(stats_ring, max(window - 1, 1))
+            metrics_win = {k: shift(v, 1, axis=-1) for k, v in rm.items()}
+            ok = ~jnp.isnan(fr_ring)
+            sums = rolling_sum(jnp.where(ok, fr_ring, 0.0), window, axis=0)
+            ctx = SelectionContext(
+                metrics_win=metrics_win, factor_ret=fr_ring,
+                ret_win_sum=shift(sums, 1, axis=0, fill_value=0.0),
+                window=window)
+
+        day_model = None
+        if risk_model is not None:
+            j = jnp.maximum(p, 0) // probe.risk_refit_every
+            hist = jnp.minimum(j * probe.risk_refit_every, lb)
+            day_model = (*risk_model, hist)
+
+        mstate2 = MarketState(
+            day=t.astype(jnp.int32), version=mstate.version + 1,
+            factors_tail=factors_tail, returns_tail=returns_tail,
+            cap_tail=cap_tail, invest_tail=invest_tail,
+            universe_tail=universe_tail, stats_ring=stats_ring,
+            fr_ring=fr_ring, lb_ring=lb_ring, risk_model=risk_model)
+        octx = OnlineCtx(
+            ctx=ctx, p=p.astype(jnp.int32), ready=ready,
+            factors_p=factors_tail[:, -2, :], returns_p=returns_tail[-2],
+            cap_p=cap_tail[-2], invest_p=invest_tail[-2],
+            universe_p=(universe_tail[-2] if has_universe else None),
+            lb_ring=lb_ring, risk_model=day_model)
+        return mstate2, octx
+
+    # --------------------------------------------------- tenant half
+
+    def _day_settings(t: TenantConfig, octx: OnlineCtx) -> SimulationSettings:
+        return dataclasses.replace(
+            probe,
+            returns=octx.returns_p[None], cap_flag=octx.cap_p[None],
+            investability_flag=octx.invest_p[None],
+            universe=(octx.universe_p[None] if has_universe else None),
+            max_weight=t.max_weight, pct=t.pct,
+            shrinkage_intensity=t.shrinkage_intensity,
+            turnover_penalty=t.turnover_penalty,
+            return_weight=t.return_weight, tcost_scale=t.tcost_scale)
+
+    def _day_weights(t, tstate, octx, masked, s):
+        """One date's weight row through the scheme's EXACT per-day
+        semantics: equal/linear are the engine's direct per-date calls;
+        the QP schemes ride ``backtest.mvo._solve_day`` (the shared day
+        step the scan/parallel/suffix paths already agree on) with the
+        carried warm state injected, then the per-day slice of
+        ``mvo._finalize``'s masking."""
+        p = octx.p
+        p_idx = jnp.maximum(p, 0)
+        pos, neg, flat = leg_masks(masked)
+        nan_d = jnp.full((), jnp.nan, dtype)
+        if method == "equal":
+            w, lc, sc = equal_weights(masked[None], t.pct)
+            return (w[0], lc[0], sc[0], nan_d, jnp.ones((), bool),
+                    tstate.warm, tstate.warm_ring)
+        if method == "linear":
+            w, lc, sc = linear_weights(masked[None], t.max_weight)
+            return (w[0], lc[0], sc[0], nan_d, jnp.ones((), bool),
+                    tstate.warm, tstate.warm_ring)
+
+        if has_universe:
+            ucount = octx.universe_p.sum()
+        else:
+            ucount = jnp.asarray(n)
+        zero_day = flat | (ucount < 2)
+        today = jnp.minimum(p_idx, lb).astype(jnp.int32)
+        warm, warm_ring = tstate.warm, tstate.warm_ring
+        if method == "mvo":
+            # the full recompute's chunked lanes warm-start day t from day
+            # t - mvo_batch; the slot ring reproduces that chain exactly
+            warm_in = None
+            if tstate.warm_ring is not None:
+                slot = (p_idx % mvo_batch).astype(jnp.int32)
+                warm_in = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_index_in_dim(a, slot, 0,
+                                                       keepdims=False),
+                    tstate.warm_ring)
+            w, resid, okc, state, _polish = _solve_day(
+                masked, octx.lb_ring, today, jnp.zeros((n,), dtype), s,
+                turnover=False, risk_model=octx.risk_model, warm=warm_in)
+            if tstate.warm_ring is not None:
+                warm_ring = jax.tree_util.tree_map(
+                    lambda ring, v: lax.dynamic_update_index_in_dim(
+                        ring, v, slot, 0),
+                    tstate.warm_ring, state)
+        else:  # mvo_turnover (sequential-scan semantics)
+            if has_universe:
+                nan_sig = (jnp.isnan(masked) & octx.universe_p).any()
+            else:
+                nan_sig = jnp.zeros((), bool)
+            w, resid, okc, state, _polish = _solve_day(
+                masked, octx.lb_ring, today, tstate.w_prev, s,
+                turnover=True, risk_model=octx.risk_model,
+                warm=(tstate.warm if warm_start else None),
+                force_fallback=nan_sig)
+            w = jnp.where(zero_day, 0.0, w)
+            if tstate.warm is not None:
+                warm = state
+
+        # the per-day slice of mvo._finalize: zero days, no-history k
+        # counts, acceptance masking
+        w = jnp.where(zero_day, 0.0, w)
+        lc = pos.sum()
+        sc = neg.sum()
+        if risk:
+            no_hist = p_idx < probe.risk_refit_every
+        else:
+            no_hist = p_idx == 0
+        k_long = jnp.maximum(jnp.floor(lc * t.pct), 1.0).astype(lc.dtype)
+        k_short = jnp.maximum(jnp.floor(sc * t.pct), 1.0).astype(sc.dtype)
+        lc = jnp.where(no_hist, k_long, lc)
+        sc = jnp.where(no_hist, k_short, sc)
+        okc = okc | zero_day | no_hist
+        zero = jnp.zeros_like(lc)
+        lc = jnp.where(zero_day, zero, lc)
+        sc = jnp.where(zero_day, zero, sc)
+        resid = jnp.where(zero_day | no_hist, jnp.nan, resid)
+        return w, lc, sc, resid, okc, warm, warm_ring
+
+    def advance_tenant(t: TenantConfig, tstate: TenantState,
+                       octx: OnlineCtx):
+        p, ready = octx.p, octx.ready
+        # 1. selection: the selector over the ring context, read at the
+        # finalized date's ring column, then the per-row slice of
+        # finalize_selection (processed iff p >= window; p <= D-2 holds
+        # by construction — p's successor has arrived)
+        kwargs = dict(select_static)
+        if select_method == "icir_top":
+            kwargs.update(top_x=t.top_k, icir_threshold=t.icir_threshold)
+        with obs_stage("online/selection"):
+            raw = selector(octx.ctx, **kwargs)[q_p]          # [F]
+            if t.manager_mix is not None:
+                raw = raw * t.manager_mix
+            processed = ready & (p >= window)
+            raw = jnp.where(processed, raw, 0.0)
+            raw = jnp.where(jnp.isnan(raw), 0.0, raw)
+            rowsum = raw.sum()
+            sel = jnp.where(rowsum > 0,
+                            raw / jnp.where(rowsum > 0, rowsum, 1.0), 0.0)
+        # 2. single-date blend (every op inside is per-date)
+        with obs_stage("online/blend"):
+            signal = composite_weighted(
+                octx.factors_p[:, None, :], names, sel[None, :],
+                method=template.blend_method,
+                universe=(octx.universe_p[None] if has_universe else None),
+                group_tilt=t.blend_tilt)[0]
+        # 3. the day's weight solve
+        s = _day_settings(t, octx)
+        masked = signal * octx.invest_p
+        with obs_stage("online/solve"):
+            w, lc, sc, resid, okc, warm, warm_ring = _day_weights(
+                t, tstate, octx, masked, s)
+        # 4. per-symbol masked weight shift (trade on yesterday's book):
+        # the carry reproduces masked_shift's compact-shift-scatter — a
+        # symbol's k-th present date trades its (k-1)-th present book
+        with obs_stage("online/shift_pnl"):
+            if has_universe:
+                traded = jnp.where(octx.universe_p, tstate.book_carry,
+                                   jnp.nan)
+                book_carry = jnp.where(octx.universe_p, w,
+                                       tstate.book_carry)
+            else:
+                traded = tstate.book_carry
+                book_carry = w
+            # 5. single-date P&L (backtest.pnl.daily_portfolio_returns
+            # row semantics; first date's turnover diff is 0)
+            wt = jnp.nan_to_num(traded)
+            r = jnp.nan_to_num(octx.returns_p)
+            longs = jnp.maximum(wt, 0.0)
+            shorts = jnp.abs(jnp.minimum(wt, 0.0))
+            long_ret_raw = (longs * r).sum()
+            short_ret_raw = -(shorts * r).sum()
+            prev = jnp.nan_to_num(tstate.traded_prev)
+            dlong = jnp.where(p > 0,
+                              jnp.abs(longs - jnp.maximum(prev, 0.0)), 0.0)
+            dshort = jnp.where(
+                p > 0, jnp.abs(shorts - jnp.abs(jnp.minimum(prev, 0.0))),
+                0.0)
+            rates = s.cost_rates()[0]
+            l_cost = (dlong * rates).sum()
+            s_cost = (dshort * rates).sum()
+            if probe.transaction_cost:
+                long_ret = long_ret_raw - l_cost
+                short_ret = short_ret_raw - s_cost
+            else:
+                long_ret, short_ret = long_ret_raw, short_ret_raw
+            lbn = tstate.long_pnl_by_name + jnp.where(
+                ready, longs * r - dlong * rates, 0.0)
+            sbn = tstate.short_pnl_by_name + jnp.where(
+                ready, -(shorts * r) - dshort * rates, 0.0)
+
+        new = TenantState(
+            w_prev=w, book_carry=book_carry, traded_prev=traded,
+            warm=warm, warm_ring=warm_ring,
+            long_pnl_by_name=lbn, short_pnl_by_name=sbn)
+        # the very first ingested date finalizes nothing: hold every
+        # carry so the stream's day-0 step stays the recompute's day-0
+        tstate2 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(ready, a, b), new, tstate)
+        out = AdvanceOutputs(
+            ready=ready, day=p, selection=sel, signal=signal,
+            weights=traded, long_count=lc, short_count=sc,
+            log_return=long_ret + short_ret, long_return=long_ret,
+            short_return=short_ret, long_turnover=dlong.sum(),
+            short_turnover=dshort.sum(),
+            turnover=dlong.sum() + dshort.sum(),
+            resid=resid, solver_ok=okc)
+        return tstate2, out
+
+    return init_market, init_tenant, advance_market, advance_tenant
+
+
+def make_online_step(*, names, template: TenantConfig | None = None,
+                     n_assets: int, dtype=jnp.float64,
+                     has_universe: bool = False, stats_tail: int = 8):
+    """Single-config convenience over :func:`online_step_parts`: returns
+    ``(init_fn, advance_fn)`` where ``init_fn() -> (mstate, tstate)`` and
+    ``advance_fn(tenant, mstate, tstate, date_slice) -> ((mstate',
+    tstate'), AdvanceOutputs)`` is one jittable per-date advance — the
+    engine's unit of work and the differential ladder's subject."""
+    template = template or TenantConfig()
+    im, it, am, at = online_step_parts(
+        names=names, template=template, n_assets=n_assets, dtype=dtype,
+        has_universe=has_universe, stats_tail=stats_tail)
+
+    def init_fn():
+        return im(), it()
+
+    def advance_fn(tenant, mstate, tstate, date_slice):
+        mstate2, octx = am(mstate, date_slice)
+        tstate2, out = at(tenant, tstate, octx)
+        return (mstate2, tstate2), out
+
+    return init_fn, advance_fn

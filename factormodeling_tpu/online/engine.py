@@ -1,0 +1,521 @@
+"""The online-advance host loop: exactly-once ingestion with restatement
+replay and crash-consistent resume.
+
+The robustness contract (ROADMAP item 1; the acceptance grid of
+``tools/chaos.py --online``): every ingested date terminates in EXACTLY
+ONE of
+
+- **APPLIED** — the date advanced the state machine; its outputs are the
+  newly finalized date's research-step row (``AdvanceOutputs``);
+- **REPLAYED** — a restated date rolled the state back to the snapshot
+  taken before its original application and re-applied the corrected
+  slice plus every journaled successor (outputs carry every re-finalized
+  row). A restatement beyond the snapshot horizon takes the EXPLICIT
+  full-recompute fallback (replay from genesis over the retained
+  history, counted in ``full_recompute_fallbacks``) — or is REJECTED
+  with ``restate_beyond_horizon`` when history retention is off;
+- **REJECTED** — out-of-order or duplicate date ids, structurally
+  malformed slices (wrong fields or shapes), NaN-storm slices (the PR 4
+  watchdog's feed-level read: in-universe factor NaN fraction above the
+  guard), and universe collapses below the guard's ``min_universe`` are
+  refused WITH A REASON, never silently applied.
+
+``ingested == applied + replayed + rejected`` always (the completeness
+invariant ``tools/trace_report.py --strict`` checks from the
+``kind="online"`` report row, and ``obs/regression.py`` gates the growth
+of ``rejected_dates`` / ``replayed_dates`` / ``full_recompute_fallbacks``
+against a baseline).
+
+Crash consistency: after every applied date (thinned by
+``checkpoint_every``) the full engine state — advance pytrees, snapshot
+ring, journal, counters, the applied-id set, and a rolling content
+fingerprint chain — snapshots atomically through ``resil.checkpoint``
+under a config-fingerprint meta guard. A SIGKILL between apply and save
+loses at most the unsaved tail, which the at-least-once feeder re-sends:
+a re-sent already-applied date is REJECTED as a duplicate (the
+exactly-once half), a never-applied one applies normally (the no-lost-
+date half), and the resumed stream's outputs are byte-equal to a
+straight-through run (the kill/resume differential in
+``tests/test_online.py`` and the chaos preset's stdout comparison).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from collections import deque
+
+import jax
+import numpy as np
+
+from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.compile_log import entry_point_tag, instrument_jit
+from factormodeling_tpu.online.advance import make_online_step
+from factormodeling_tpu.online.state import DateSlice
+from factormodeling_tpu.serve.tenant import TenantConfig
+
+__all__ = ["EngineGuards", "OnlineEngine", "OnlineVerdict"]
+
+#: test hook: _exit(137) right after the checkpoint save of this date id —
+#: the mid-stream SIGKILL of the resume differential (tools/chaos.py
+#: --online rides it over the real CLI)
+_DIE_ENV = "_FMT_ONLINE_DIE_AFTER_DATE"
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineGuards:
+    """Feed-level admission guards. The defaults are the OPEN policy
+    (every well-ordered date applies); ``guarded`` thresholds reject
+    anomalous slices with explicit reasons instead of folding corrupt
+    evidence into the rolling state."""
+
+    nan_frac_max: float | None = None   # None disables the NaN-storm guard
+    min_universe: int = 0               # 0 disables the collapse guard
+
+    @classmethod
+    def open(cls) -> "EngineGuards":
+        return cls()
+
+    @classmethod
+    def guarded(cls, *, nan_frac_max: float = 0.5,
+                min_universe: int = 2) -> "EngineGuards":
+        return cls(nan_frac_max=nan_frac_max, min_universe=min_universe)
+
+
+@dataclasses.dataclass(frozen=True)
+class OnlineVerdict:
+    """One ingested date's terminal verdict (module docs)."""
+
+    date: int
+    status: str                 # "applied" | "replayed" | "rejected"
+    reason: str | None = None   # rejection reason / replay kind
+    outputs: tuple = ()         # finalized-row dicts (host numpy)
+    replayed_dates: tuple = ()  # date ids re-applied by a replay
+
+
+def _host_slice(d: DateSlice) -> dict:
+    return {k: np.asarray(v) for k, v in d._asdict().items()
+            if v is not None}
+
+
+def _slice_from_host(h: dict):
+    import jax.numpy as jnp
+
+    uni = h.get("universe")
+    return DateSlice(factors=jnp.asarray(h["factors"]),
+                     returns=jnp.asarray(h["returns"]),
+                     factor_ret=jnp.asarray(h["factor_ret"]),
+                     cap_flag=jnp.asarray(h["cap_flag"]),
+                     investability=jnp.asarray(h["investability"]),
+                     universe=None if uni is None else jnp.asarray(uni))
+
+
+def _out_to_host(o) -> dict:
+    return {k: np.asarray(v) for k, v in o._asdict().items()}
+
+
+class OnlineEngine:
+    """Single-config online advance with the robustness contract (module
+    docs). The many-tenant fan-out is ``TenantServer.online_begin`` /
+    ``advance_all`` (``serve/frontend.py``), which shares the advance
+    internals but not this host loop.
+
+    Args:
+      names: factor names (the blend's prefix/suffix convention).
+      n_assets: cross-section width N.
+      template: the research configuration
+        (:class:`~factormodeling_tpu.serve.tenant.TenantConfig`);
+        defaults to the repo's single-config defaults.
+      has_universe: whether slices carry a universe mask (structural —
+        decided once per engine, like the offline step's trace).
+      horizon: R, the snapshot/journal ring depth — how many most recent
+        applied dates can be restated via bounded rollback-and-replay.
+      guards: :class:`EngineGuards` (default open).
+      checkpoint: optional path or ``resil.Checkpointer`` — crash
+        consistency (module docs); ``checkpoint_every`` thins saves when
+        a path is given.
+      retain_history: keep every applied slice host-side so a
+        beyond-horizon restatement can take the full-recompute fallback
+        (O(history) — explicit and counted); off -> such restatements
+        are rejected.
+      checkpoint_history: include the retained history in every
+        checkpoint (default True — full recovery semantics survive a
+        restart). HONEST COST: each save re-serializes the whole
+        retained set, so per-save bytes grow linearly with stream length
+        — O(T^2) cumulative over a long feed. Production streams should
+        either thin with ``checkpoint_every`` or set this False: saves
+        then stay O(window + horizon) forever, and after a RESUME a
+        beyond-horizon restatement degrades to an explicit
+        ``restate_beyond_horizon`` rejection (the engine knows its
+        history is partial; in-ring rollback-and-replay is unaffected).
+      stats_tail / dtype: threaded to
+        :func:`~factormodeling_tpu.online.advance.online_step_parts`.
+    """
+
+    def __init__(self, *, names, n_assets: int, template=None,
+                 has_universe: bool = False, horizon: int = 8,
+                 guards: EngineGuards | None = None, checkpoint=None,
+                 checkpoint_every: int = 1, retain_history: bool = True,
+                 checkpoint_history: bool = True,
+                 stats_tail: int = 8, dtype=None, progress=None):
+        import jax.numpy as jnp
+
+        from factormodeling_tpu.composite import prefix_group_ids
+
+        self.names = tuple(names)
+        self.n_assets = int(n_assets)
+        self.horizon = int(horizon)
+        if self.horizon < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        self.guards = guards or EngineGuards.open()
+        self.retain_history = bool(retain_history)
+        self.checkpoint_history = bool(checkpoint_history)
+        self._progress = progress or (lambda *_: None)
+        dtype = jnp.float64 if dtype is None else dtype
+        template = template if template is not None else TenantConfig()
+        _, prefixes = prefix_group_ids(self.names)
+        self.template = template.normalized(len(self.names), len(prefixes),
+                                            dtype=np.dtype(dtype))
+        self._has_universe = bool(has_universe)
+        init_fn, advance_fn = make_online_step(
+            names=self.names, template=self.template,
+            n_assets=self.n_assets, dtype=dtype,
+            has_universe=has_universe, stats_tail=stats_tail)
+        self._config_tag = entry_point_tag(
+            self.names, self.n_assets, str(self.template.static_key()),
+            has_universe, stats_tail, str(np.dtype(dtype)))
+        # one compiled advance serves the whole stream (a second signature
+        # is the classic silent-retrace bug — the detector watches it)
+        self._advance = instrument_jit(
+            jax.jit(advance_fn),
+            f"online/engine/{self._config_tag}", expected_signatures=1)
+        self._init_fn = init_fn
+        self._state = init_fn()
+        self._treedef = jax.tree_util.tree_structure(self._state)
+        self._applied: list = []
+        self._applied_set: set = set()
+        # ring entries are (date_id, state-leaves BEFORE applying date_id)
+        self._snapshots: deque = deque(maxlen=self.horizon)
+        self._journal: deque = deque(maxlen=self.horizon)
+        self._history: list = []
+        # False after a resume restored fewer slices than applied dates
+        # (checkpoint_history=False): the genesis-replay fallback would
+        # silently rebuild over a truncated prefix, so it is disabled
+        self._history_complete = True
+        # append-only AUDIT chain: every application ever made folds in
+        # (replays included — a ring rollback cannot rewind a rolling
+        # hash, so superseded applications stay in the chain). It is
+        # deterministic for a given ingestion sequence — the kill/resume
+        # byte-equality anchor — but deliberately NOT the content hash
+        # of the current logical stream.
+        self._chain = hashlib.sha256(self._config_tag.encode()).hexdigest()
+        self.counters = {"ingested_dates": 0, "applied_dates": 0,
+                         "replayed_dates": 0, "rejected_dates": 0,
+                         "replay_applied_dates": 0,
+                         "full_recompute_fallbacks": 0}
+        self.rejected_reasons: dict = {}
+
+        self._ck = None
+        if checkpoint is not None:
+            from factormodeling_tpu import resil
+
+            self._ck = (checkpoint if isinstance(checkpoint,
+                                                 resil.Checkpointer)
+                        else resil.Checkpointer(checkpoint,
+                                                every=checkpoint_every))
+            self._maybe_resume()
+
+    # ------------------------------------------------------------ state io
+
+    def _leaves(self, state) -> list:
+        return [np.asarray(x) for x in jax.tree_util.tree_leaves(state)]
+
+    def _unleaves(self, leaves):
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [jnp.asarray(x) for x in leaves])
+
+    def _ck_meta(self) -> dict:
+        return {"entry": "online_engine", "config": self._config_tag,
+                "horizon": self.horizon,
+                "retain_history": self.retain_history}
+
+    def _save(self, *, force: bool = False):
+        if self._ck is None:
+            return
+        state = {
+            "state": self._leaves(self._state),
+            "applied": list(self._applied),
+            "chain": self._chain,
+            "counters": dict(self.counters),
+            "rejected_reasons": dict(self.rejected_reasons),
+            "snapshots": [[int(d), leaves]
+                          for d, leaves in self._snapshots],
+            "journal": [[int(d), h] for d, h in self._journal],
+            "history": ([[int(d), h] for d, h in self._history]
+                        if self.retain_history and self.checkpoint_history
+                        else []),
+        }
+        if force:
+            self._ck.save(state, meta=self._ck_meta())
+        else:
+            self._ck.maybe_save(self.counters["applied_dates"] - 1, state,
+                                meta=self._ck_meta())
+
+    def _maybe_resume(self):
+        got = self._ck.resume(expect_meta=self._ck_meta())
+        if got is None:
+            return
+        state, _ = got
+        self._state = self._unleaves(state["state"])
+        self._applied = [int(d) for d in state["applied"]]
+        self._applied_set = set(self._applied)
+        self._chain = str(state["chain"])
+        self.counters.update({k: int(v)
+                              for k, v in state["counters"].items()})
+        self.rejected_reasons = {k: int(v) for k, v in
+                                 state["rejected_reasons"].items()}
+        self._snapshots = deque(
+            [(int(d), leaves) for d, leaves in state["snapshots"]],
+            maxlen=self.horizon)
+        self._journal = deque(
+            [(int(d), h) for d, h in state["journal"]],
+            maxlen=self.horizon)
+        self._history = [(int(d), h) for d, h in state["history"]]
+        self._history_complete = (
+            {d for d, _ in self._history} == set(self._applied))
+        self._progress(f"online: resumed at date {self.last_date} "
+                       f"({self.counters['applied_dates']} applied) "
+                       f"from {self._ck.path}")
+
+    # ----------------------------------------------------------- verdicts
+
+    @property
+    def last_date(self):
+        return self._applied[-1] if self._applied else None
+
+    @property
+    def version(self) -> int:
+        return int(np.asarray(self._state[0].version))
+
+    def _reject(self, date: int, reason: str) -> OnlineVerdict:
+        self.counters["rejected_dates"] += 1
+        self.rejected_reasons[reason] = \
+            self.rejected_reasons.get(reason, 0) + 1
+        self._record()
+        return OnlineVerdict(date=int(date), status="rejected",
+                             reason=reason)
+
+    def _guard_reason(self, h: dict):
+        g = self.guards
+        if g.nan_frac_max is not None:
+            fac = h["factors"]
+            if "universe" in h:
+                uni = h["universe"][None]
+                denom = max(int(uni.sum()) * fac.shape[0], 1)
+                nans = int((np.isnan(fac) & uni).sum())
+            else:
+                denom = fac.size
+                nans = int(np.isnan(fac).sum())
+            if nans / denom > g.nan_frac_max:
+                return "nan_storm"
+        if g.min_universe > 0:
+            count = (int(h["universe"].sum()) if "universe" in h
+                     else h["returns"].shape[-1])
+            if count < g.min_universe:
+                return "universe_collapse"
+        return None
+
+    def _slice_reason(self, h: dict):
+        """Host-side admission check of the slice's structure: a
+        malformed tick must terminate in a REJECTED verdict, not escape
+        as a trace error after the ingestion counter moved (which would
+        break the completeness invariant for the rest of the stream)."""
+        f, n = len(self.names), self.n_assets
+        want = {"factors": (f, n), "returns": (n,), "factor_ret": (f,),
+                "cap_flag": (n,), "investability": (n,)}
+        if self._has_universe:
+            want["universe"] = (n,)
+        if set(h) != set(want):
+            return "bad_slice_fields"
+        for key, shape in want.items():
+            if h[key].shape != shape:
+                return "bad_slice_shape"
+        return None
+
+    def _apply_one(self, date: int, h: dict, *, replaying: bool) -> list:
+        """Advance the state machine by one slice; returns the finalized
+        output rows. The pre-apply snapshot enters the ring at the
+        position BEFORE applying ``date`` (so a later restatement can
+        roll back before it) but only once the advance succeeded — a
+        raising dispatch must not leave a phantom ring entry."""
+        pre = (int(date), self._leaves(self._state))
+        (mstate, tstate), out = self._advance(
+            self.template, self._state[0], self._state[1],
+            _slice_from_host(h))
+        jax.block_until_ready(mstate.version)
+        self._snapshots.append(pre)
+        self._state = (mstate, tstate)
+        self._journal.append((int(date), h))
+        if self.retain_history and not replaying:
+            self._history.append((int(date), h))
+        self._applied.append(int(date))
+        self._applied_set.add(int(date))
+        ch = hashlib.sha256()
+        ch.update(bytes.fromhex(self._chain))
+        ch.update(np.int64(date).tobytes())
+        for key in sorted(h):
+            ch.update(np.ascontiguousarray(h[key]).tobytes())
+        self._chain = ch.hexdigest()
+        host = _out_to_host(out)
+        return [host] if bool(host["ready"]) else []
+
+    def ingest(self, date: int, date_slice: DateSlice,
+               restate: bool = False) -> OnlineVerdict:
+        """One feed tick -> one terminal verdict (module docs)."""
+        date = int(date)
+        self.counters["ingested_dates"] += 1
+        h = _host_slice(date_slice)
+        reason = self._slice_reason(h)
+        if reason is not None:
+            return self._reject(date, reason)
+        if restate:
+            return self._ingest_restatement(date, h)
+        if self._applied and date <= self._applied[-1]:
+            return self._reject(
+                date, "duplicate" if date in self._applied_set
+                else "out_of_order")
+        reason = self._guard_reason(h)
+        if reason is not None:
+            return self._reject(date, reason)
+        outs = self._apply_one(date, h, replaying=False)
+        self.counters["applied_dates"] += 1
+        self._save()
+        self._record()
+        self._die_hook(date)
+        return OnlineVerdict(date=date, status="applied",
+                             outputs=tuple(outs))
+
+    def _ingest_restatement(self, date: int, h: dict) -> OnlineVerdict:
+        if date not in self._applied_set:
+            return self._reject(date, "restate_unknown")
+        # a corrected slice passes the SAME admission guards as a fresh
+        # one: a guarded engine must not fold a NaN-storm or collapsed
+        # restatement into its rolling state just because the date id is
+        # known ("rejected or degraded with explicit reasons, never
+        # silently applied" — the module contract)
+        reason = self._guard_reason(h)
+        if reason is not None:
+            return self._reject(date, reason)
+        ring_dates = [d for d, _ in self._snapshots]
+        if date in ring_dates:
+            verdict = self._rollback_replay(date, h)
+        elif (self.retain_history and self._history_complete
+              and any(d == date for d, _ in self._history)):
+            self.counters["full_recompute_fallbacks"] += 1
+            verdict = self._replay_from_genesis(date, h)
+        else:
+            # beyond every recovery horizon: no ring snapshot and no
+            # COMPLETE retained stream to rebuild from (retention off,
+            # or a resume whose checkpoint omitted history — membership
+            # alone is not enough: a post-resume date sits in a history
+            # whose pre-resume prefix is gone, and a genesis replay over
+            # that truncated prefix would silently diverge) — explicit
+            # rejection, never a silent partial replay
+            return self._reject(date, "restate_beyond_horizon")
+        self.counters["replayed_dates"] += 1
+        self._save(force=True)
+        self._record()
+        self._die_hook(date)
+        return verdict
+
+    def _patch_history(self, date: int, h: dict):
+        if self.retain_history:
+            self._history = [(d, h if d == date else old)
+                             for d, old in self._history]
+
+    def _rollback_replay(self, date: int, h: dict) -> OnlineVerdict:
+        """Bounded rollback: restore the pre-apply snapshot of the
+        restated date, then re-apply it (corrected) and every journaled
+        successor, rebuilding the ring as it goes."""
+        tail = [(d, (h if d == date else old))
+                for d, old in self._journal if d >= date]
+        idx = next(i for i, (d, _) in enumerate(self._snapshots)
+                   if d == date)
+        _, leaves = self._snapshots[idx]
+        self._state = self._unleaves(leaves)
+        # drop ring entries from the restated date on — the replay
+        # re-creates them against the corrected stream
+        while len(self._snapshots) > idx:
+            self._snapshots.pop()
+        self._journal = deque(
+            [(d, old) for d, old in self._journal if d < date],
+            maxlen=self.horizon)
+        self._applied = [d for d in self._applied if d < date]
+        self._applied_set = set(self._applied)
+        self._patch_history(date, h)
+        outs: list = []
+        replayed: list = []
+        for d, hd in tail:
+            outs.extend(self._apply_one(d, hd, replaying=True))
+            replayed.append(d)
+            self.counters["replay_applied_dates"] += 1
+        return OnlineVerdict(date=date, status="replayed", reason="ring",
+                             outputs=tuple(outs),
+                             replayed_dates=tuple(replayed))
+
+    def _replay_from_genesis(self, date: int, h: dict) -> OnlineVerdict:
+        """The beyond-horizon fallback: an EXPLICIT O(history) full
+        recompute — fresh state, every retained slice re-applied with the
+        restated date corrected. Counted, never silent. The audit chain
+        is NOT reset: like the ring path, the replay appends onto it, so
+        both replay paths share one semantics (every application ever
+        made, superseded ones included)."""
+        self._patch_history(date, h)
+        self._state = self._init_fn()
+        self._snapshots.clear()
+        self._journal = deque(maxlen=self.horizon)
+        self._applied = []
+        self._applied_set = set()
+        outs: list = []
+        replayed: list = []
+        for d, hd in self._history:
+            outs.extend(self._apply_one(d, hd, replaying=True))
+            replayed.append(d)
+            self.counters["replay_applied_dates"] += 1
+        return OnlineVerdict(date=date, status="replayed",
+                             reason="full_recompute", outputs=tuple(outs),
+                             replayed_dates=tuple(replayed))
+
+    # ---------------------------------------------------------- telemetry
+
+    def _die_hook(self, date: int):
+        die_after = os.environ.get(_DIE_ENV)
+        if die_after is not None and int(die_after) == int(date):
+            self._progress(f"online: dying after date {date} "
+                           f"({_DIE_ENV} test hook)")
+            os._exit(137)
+
+    def _record(self):
+        record_stage(f"online/engine/{self._config_tag}", kind="online",
+                     **self.report_fields())
+
+    def report_fields(self) -> dict:
+        """The ``kind="online"`` row body: the verdict counters (whose
+        completeness ``trace_report --strict`` checks), the reason
+        breakdown, and the stream position."""
+        return {**self.counters,
+                "rejected_reasons": dict(self.rejected_reasons),
+                "last_date": self.last_date,
+                "state_version": self.version,
+                "horizon": self.horizon}
+
+    def verdict_complete(self) -> bool:
+        """The completeness invariant: every ingestion terminated in
+        exactly one verdict."""
+        c = self.counters
+        return c["ingested_dates"] == (c["applied_dates"]
+                                       + c["replayed_dates"]
+                                       + c["rejected_dates"])

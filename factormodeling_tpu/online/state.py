@@ -1,0 +1,187 @@
+"""Online-advance state pytrees: the O(window) carry of the research step.
+
+ROADMAP item 1: the full research step is O(history) per arriving date —
+every workload recomputes the whole ``[D, N]`` panel to answer "what does
+today change?". This module defines the state an exactly-incremental
+advance carries instead, split along the serving layer's hoist line
+(``serve/batched.py``):
+
+- :class:`MarketState` — everything derived from the MARKET alone, shared
+  by every tenant of a signature bucket: raw-input tail rings (the last
+  ``stats_tail`` dates of exposures/returns/universe, enough to recompute
+  one date's :func:`~factormodeling_tpu.metrics.daily_factor_stats` under
+  the double exposure shift), the rolling IC/ICIR stats ring and
+  factor-return ring sized to the lookback window (the selection context
+  rebuilds from these alone), the left-aligned covariance-lookback
+  returns ring the MVO schemes' trailing sample window slices from, the
+  current statistical risk model under ``covariance="risk_model"``, and
+  the monotone ``version`` counter every applied date bumps.
+- :class:`TenantState` — the per-tenant sequential carries: the previous
+  pre-shift book (the turnover L1 center AND the source the per-symbol
+  masked weight shift trades from), the per-symbol shift carry, the
+  previous traded row (the P&L turnover diff), the day-over-day ADMM
+  warm state (``ADMMWarmState`` — the PR 6 carry contract) for the
+  turnover scan plus a ``mvo_batch``-slot ring of lane exit states for
+  plain MVO (day ``t`` warm-starts from day ``t - mvo_batch`` in the
+  full recompute's chunked lanes, so the ring reproduces the chain
+  bit-for-bit), and the running per-name P&L accumulators.
+
+Every array is a fixed-shape traced leaf — one compiled advance serves
+the whole stream — and ring ramp-up is encoded by NaN/False padding whose
+contribution to every downstream reducer is bit-identical to the full
+recompute's edge padding (the equality the differential ladder in
+``tests/test_online.py`` pins). The bit-for-bit contract and its honest
+limits (ring horizons, warm-chain preconditions) are documented on
+:func:`factormodeling_tpu.online.advance.online_step_parts`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from factormodeling_tpu.solvers import ADMMWarmState
+
+__all__ = ["DateSlice", "MarketState", "TenantState", "AdvanceOutputs"]
+
+
+class DateSlice(NamedTuple):
+    """One arriving date's raw inputs — the unit the online engine ingests.
+
+    ``universe`` participates by PRESENCE (the repo's elision idiom): a
+    ``None`` leaf is structurally absent, so a no-universe stream traces
+    the plain-shift program exactly like the offline step."""
+
+    factors: jnp.ndarray          # float[F, N] raw exposures for the date
+    returns: jnp.ndarray          # float[N] asset returns
+    factor_ret: jnp.ndarray       # float[F] precomputed factor returns
+    cap_flag: jnp.ndarray         # float[N] cap tier
+    investability: jnp.ndarray    # float[N]
+    universe: Any = None          # bool[N] membership, or None
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MarketState:
+    """Bucket-shared market carry (module docs). ``day`` is the absolute
+    index of the LAST ingested date (-1 before the first); the finalized
+    date of an advance is ``day - 1`` — the last date of any full
+    recompute is transient (zero selection, ``dates[window:-1]``), so the
+    online step emits a date only once its successor has arrived and its
+    row can never be restated by normal flow again."""
+
+    day: jnp.ndarray              # int32[] last ingested absolute index
+    version: jnp.ndarray          # int32[] monotone, +1 per advance
+    factors_tail: jnp.ndarray     # [F, T, N] last T dates (NaN ramp pad)
+    returns_tail: jnp.ndarray     # [T, N]
+    cap_tail: jnp.ndarray         # [T, N]
+    invest_tail: jnp.ndarray      # [T, N]
+    universe_tail: Any            # bool[T, N] (False ramp pad) or None
+    stats_ring: dict              # stat -> float[F, R] (NaN ramp pad)
+    fr_ring: jnp.ndarray          # float[R, F] factor returns (NaN pad)
+    lb_ring: Any                  # float[LB, N] left-aligned, or None
+    risk_model: Any               # (loadings [N,k], fvar [k], idio [N]) or None
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TenantState:
+    """Per-tenant sequential carry (module docs)."""
+
+    w_prev: jnp.ndarray           # [N] previous final PRE-shift book
+    book_carry: jnp.ndarray       # [N] last in-universe pre-shift weight
+    traded_prev: jnp.ndarray      # [N] previous traded (shifted) row, raw
+    warm: Any                     # ADMMWarmState [N] leaves, or None
+    warm_ring: Any                # ADMMWarmState [B, N] leaves, or None
+    long_pnl_by_name: jnp.ndarray   # [N] running after-cost long P&L
+    short_pnl_by_name: jnp.ndarray  # [N] running after-cost short P&L
+
+
+class AdvanceOutputs(NamedTuple):
+    """The newly FINALIZED date's research-step row (the incremental
+    analog of one date of :class:`~factormodeling_tpu.parallel.pipeline.
+    ResearchOutput`). ``ready`` is False for the very first ingested date
+    (nothing behind it to finalize); per-name cumulative P&L rides the
+    :class:`TenantState` accumulators instead (a running sum's
+    association order differs from the recompute's tree reduction, so it
+    is honest-tolerance, not bit-for-bit — module docs)."""
+
+    ready: jnp.ndarray            # bool[]
+    day: jnp.ndarray              # int32[] finalized absolute date index
+    selection: jnp.ndarray        # [F] daily factor weights
+    signal: jnp.ndarray           # [N] composite signal
+    weights: jnp.ndarray          # [N] traded (shifted) book
+    long_count: jnp.ndarray       # int[]
+    short_count: jnp.ndarray      # int[]
+    log_return: jnp.ndarray       # [] net daily return
+    long_return: jnp.ndarray      # []
+    short_return: jnp.ndarray     # []
+    long_turnover: jnp.ndarray    # []
+    short_turnover: jnp.ndarray   # []
+    turnover: jnp.ndarray         # []
+    resid: jnp.ndarray            # [] final ADMM primal residual (NaN = n/a)
+    solver_ok: jnp.ndarray        # bool[]
+
+
+def _cold_warm(shape, dtype) -> ADMMWarmState:
+    """Cold ADMM state (zeros; rho NaN = the solver's cold sentinel),
+    matching ``backtest.mvo._cold_state``."""
+    lead = shape[:-1]
+    return ADMMWarmState(z=jnp.zeros(shape, dtype),
+                         u=jnp.zeros(shape, dtype),
+                         rho=jnp.full(lead, jnp.nan, dtype))
+
+
+def init_market_state(*, n_factors: int, n_assets: int, dtype,
+                      stats_needs: tuple, tail: int, ring: int,
+                      lb: int | None, has_universe: bool,
+                      risk_factors: int | None = None) -> MarketState:
+    """Empty market state: NaN/False ramp padding everywhere (state.py
+    module docs derive why that padding is bit-equivalent to the full
+    recompute's edge behavior)."""
+    f, n = int(n_factors), int(n_assets)
+    nan_fdn = jnp.full((f, tail, n), jnp.nan, dtype)
+    nan_dn = jnp.full((tail, n), jnp.nan, dtype)
+    rm = None
+    if risk_factors is not None:
+        rm = (jnp.full((n, risk_factors), jnp.nan, dtype),
+              jnp.full((risk_factors,), jnp.nan, dtype),
+              jnp.full((n,), jnp.nan, dtype))
+    return MarketState(
+        day=jnp.asarray(-1, jnp.int32),
+        version=jnp.asarray(0, jnp.int32),
+        factors_tail=nan_fdn,
+        returns_tail=nan_dn,
+        cap_tail=jnp.zeros((tail, n), dtype),
+        invest_tail=jnp.zeros((tail, n), dtype),
+        universe_tail=(jnp.zeros((tail, n), bool) if has_universe else None),
+        stats_ring={k: jnp.full((f, ring), jnp.nan, dtype)
+                    for k in stats_needs},
+        fr_ring=jnp.full((ring, f), jnp.nan, dtype),
+        lb_ring=(None if lb is None else jnp.zeros((lb, n), dtype)),
+        risk_model=rm)
+
+
+def init_tenant_state(*, n_assets: int, dtype, method: str,
+                      mvo_batch: int | None,
+                      warm_start: bool) -> TenantState:
+    """Cold tenant state. The warm carries exist only for the scheme that
+    consumes them (structural elision: equal/linear trace no solver state
+    at all; turnover carries the scan state; plain mvo the lane ring)."""
+    n = int(n_assets)
+    warm = warm_ring = None
+    if method == "mvo_turnover" and warm_start:
+        warm = _cold_warm((n,), dtype)
+    if method == "mvo" and warm_start and mvo_batch:
+        warm_ring = _cold_warm((int(mvo_batch), n), dtype)
+    return TenantState(
+        w_prev=jnp.zeros((n,), dtype),
+        book_carry=jnp.full((n,), jnp.nan, dtype),
+        traded_prev=jnp.full((n,), jnp.nan, dtype),
+        warm=warm,
+        warm_ring=warm_ring,
+        long_pnl_by_name=jnp.zeros((n,), dtype),
+        short_pnl_by_name=jnp.zeros((n,), dtype))

@@ -36,6 +36,7 @@ from factormodeling_tpu.serve.batched import (  # noqa: F401
 )
 from factormodeling_tpu.serve.frontend import (  # noqa: F401
     DEFAULT_PAD_LADDER,
+    TenantAdvance,
     TenantResult,
     TenantServer,
 )

@@ -100,6 +100,18 @@ def test_default_targets_cover_the_serving_layer():
     assert "retry.py" in resil
 
 
+def test_default_targets_cover_the_online_advance_package():
+    """Round 17 extends the surface over factormodeling_tpu/online/: the
+    engine is a per-date latency-claiming host loop — its advance p99 is
+    the product's own SLO surface, published only through the bench's
+    fenced sketches — exactly where an unfenced "time one ingest" window
+    would time async dispatch. Pinned by name so a future move out of
+    online/ can't silently drop them from the linted surface."""
+    targets = lint_timing.default_targets(REPO)
+    online = {p.name for p in targets if p.parent.name == "online"}
+    assert {"state.py", "advance.py", "engine.py"} <= online
+
+
 def test_default_targets_cover_the_scenario_engine():
     """Round 16 extends the surface over factormodeling_tpu/scenarios/:
     the engine's chunked host sweep loop is exactly where an ad-hoc

@@ -37,6 +37,19 @@ every dispatch; the ``_FMT_SERVE_DIE_AFTER_DISPATCH`` env hook kills the
 process mid-drain and a rerun resumes byte-equal (the kill/resume
 differential in tests/test_serve_queue.py).
 
+``--online`` switches to the round-17 ONLINE preset: feed-anomaly x
+engine-guard cells over the ``factormodeling_tpu.online`` state machine —
+{late date, duplicate date, restated date, NaN-storm slice, universe
+collapse, kill-after-apply} x {open, guarded}, asserting that every
+ingested date terminates in exactly one of APPLIED | REPLAYED | REJECTED
+(counts summing to ingestions), that anomalies reject WITH their reasons
+under the guarded policy and never silently corrupt state under the open
+one, that restatements replay from the snapshot ring, and that a
+kill-after-apply stream resumes from its ``resil.checkpoint`` byte-equal
+(final state digest + content chain in the cell verdict; the
+``_FMT_ONLINE_DIE_AFTER_DATE`` env hook SIGKILLs the real CLI mid-cell
+for the stdout-byte-equality differential in tests/test_online.py).
+
 ``--scenarios`` switches to the round-16 SCENARIO preset
 (``factormodeling_tpu.scenarios``, architecture.md §22): each cell runs a
 vmapped sweep of stressed MARKETS (bootstrap-resampled, regime-shifted,
@@ -655,6 +668,246 @@ def run_scenario_chaos(*, shape=(6, 48, 16), window: int = 8,
     return loop.verdict(cells)
 
 
+# ------------------------------------------------------ the online preset
+
+#: feed-anomaly classes of the ONLINE preset (module docs): each cell
+#: injects one anomaly into an otherwise clean date stream and asserts
+#: the engine's verdict contract
+ONLINE_ANOMALIES = ("late_date", "duplicate_date", "restated_date",
+                    "nan_storm", "universe_collapse", "kill_after_apply")
+ONLINE_POLICIES = ("open", "guarded")
+
+#: expected terminal verdict per (anomaly, policy): the completeness
+#: grid covers every cell — the anomaly's tick must terminate in
+#: EXACTLY this (status, reason); a ``None`` reason accepts any. The
+#: kill cells' expectation IS the exactly-once proof: the re-fed
+#: already-applied date must reject as a duplicate, never double-apply.
+ONLINE_EXPECT = {
+    ("late_date", "open"): ("rejected", "out_of_order"),
+    ("late_date", "guarded"): ("rejected", "out_of_order"),
+    ("duplicate_date", "open"): ("rejected", "duplicate"),
+    ("duplicate_date", "guarded"): ("rejected", "duplicate"),
+    ("restated_date", "open"): ("replayed", "ring"),
+    ("restated_date", "guarded"): ("replayed", "ring"),
+    ("nan_storm", "open"): ("applied", None),
+    ("nan_storm", "guarded"): ("rejected", "nan_storm"),
+    ("universe_collapse", "open"): ("applied", None),
+    ("universe_collapse", "guarded"): ("rejected", "universe_collapse"),
+    ("kill_after_apply", "open"): ("rejected", "duplicate"),
+    ("kill_after_apply", "guarded"): ("rejected", "duplicate"),
+}
+
+
+def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
+                     method: str = "equal", faults=None, policies=None,
+                     seed: int = 0, tol: float = 0.05, report=None,
+                     checkpoint_path=None, checkpoint_every: int = 1,
+                     progress=print) -> dict:
+    """The ONLINE grid: feed-anomaly x engine-guard cells over the
+    :class:`factormodeling_tpu.online.OnlineEngine`. Each cell streams
+    the synthetic panel date by date with ONE anomaly injected and
+    asserts:
+
+    - **verdict completeness**: applied + replayed + rejected ==
+      ingested, and the anomaly's tick terminated in exactly the
+      expected verdict/reason (``ONLINE_EXPECT``) — rejected or
+      degraded WITH a reason, never silently applied;
+    - **finite served rows**: every finalized date's log-return is
+      finite and its traded book obeys the weight bound;
+    - **kill/resume** (the ``kill_after_apply`` cells): the engine
+      checkpoints every applied date; the cell restarts the engine from
+      its snapshot mid-stream (and the ``_FMT_ONLINE_DIE_AFTER_DATE``
+      env hook lets the resume differential SIGKILL the real CLI
+      mid-cell), re-feeds the last applied date once (REJECTED as a
+      duplicate — the exactly-once proof), and records a digest of the
+      final state leaves + the rolling content chain: a killed-and-
+      resumed run's stdout (``--json``) is byte-equal to a
+      straight-through run's.
+
+    Returns the same JSON-ready verdict shape as :func:`run_chaos`."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from factormodeling_tpu import obs
+    from factormodeling_tpu.online import (DateSlice, EngineGuards,
+                                           OnlineEngine)
+    from factormodeling_tpu.resil import fingerprint
+    from factormodeling_tpu.serve import TenantConfig
+
+    f, d, n = shape
+    if d < 12:
+        raise ValueError(f"--online needs at least 12 dates, got {d}")
+    names, args = make_inputs(f, d, n, seed=seed)
+    factors, returns, factor_ret, cap_flag, invest, universe = \
+        (np.asarray(a) for a in args)
+    anomalies = list(faults or ONLINE_ANOMALIES)
+    unknown = set(anomalies) - set(ONLINE_ANOMALIES)
+    if unknown:
+        raise ValueError(f"unknown online anomalies {sorted(unknown)}; "
+                         f"valid: {ONLINE_ANOMALIES}")
+    policies = list(policies or ONLINE_POLICIES)
+    unknown = set(policies) - set(ONLINE_POLICIES)
+    if unknown:
+        raise ValueError(f"unknown online policies {sorted(unknown)}; "
+                         f"valid: {ONLINE_POLICIES}")
+    template = TenantConfig(top_k=max(f // 2, 1), icir_threshold=-1.0,
+                            method=method, window=window, max_weight=0.5,
+                            pct=0.25, lookback_period=min(8, d))
+    guards = {"open": EngineGuards.open(),
+              "guarded": EngineGuards.guarded(nan_frac_max=0.5,
+                                              min_universe=2)}
+
+    def slice_at(t, fac=None, uni=None):
+        fa = factors if fac is None else fac
+        un = universe if uni is None else uni
+        return DateSlice(factors=fa[:, t, :], returns=returns[t],
+                         factor_ret=factor_ret[t], cap_flag=cap_flag[t],
+                         investability=invest[t], universe=un[t])
+
+    def check_rows(verdicts) -> list:
+        bad = []
+        for v in verdicts:
+            for o in v.outputs:
+                lr = float(o["log_return"])
+                if not np.isfinite(lr):
+                    bad.append(f"date {int(o['day'])}: non-finite "
+                               f"log-return {lr}")
+                w = np.nan_to_num(np.asarray(o["weights"]))
+                if np.abs(w).max() > 1.0 + tol:
+                    bad.append(f"date {int(o['day'])}: |weight| "
+                               f"{np.abs(w).max():.3f} > 1 + {tol}")
+        return bad[:8]
+
+    rep = report if report is not None else obs.RunReport("chaos-online")
+    tmp_ctx = None
+    if checkpoint_path is None:
+        tmp_ctx = tempfile.TemporaryDirectory(prefix="chaos-online-")
+        engine_ck_base = os.path.join(tmp_ctx.name, "engine")
+    else:
+        engine_ck_base = f"{checkpoint_path}.online-engine"
+    try:
+        with rep.activate():
+            mark = len(rep.rows)
+            cells = [(a, pk) for a in anomalies for pk in policies]
+            ck_meta = {"entry": "chaos-online",
+                       "config": [list(shape), window, method, anomalies,
+                                  policies, int(seed), float(tol)]}
+            loop = CellLoop(rep, label="chaos-online", n_cells=len(cells),
+                            mark=mark, ck_meta=ck_meta,
+                            checkpoint_path=checkpoint_path,
+                            checkpoint_every=checkpoint_every,
+                            progress=progress, die_env=_DIE_ENV)
+            anomaly_at = d - 4      # the anomalous tick's date id
+            restate_of = d - 3      # in-horizon restatement target
+            kill_resume_at = d // 2
+            for idx, (anomaly, pol_name) in enumerate(cells):
+                cell = f"online/{anomaly}/{pol_name}"
+                if loop.skip(cell):
+                    continue
+                is_kill = anomaly == "kill_after_apply"
+                ck_file = (f"{engine_ck_base}.{pol_name}.snap"
+                           if is_kill else None)
+
+                def make_engine():
+                    return OnlineEngine(
+                        names=names, n_assets=n, template=template,
+                        has_universe=True, horizon=6,
+                        guards=guards[pol_name], checkpoint=ck_file,
+                        retain_history=True, dtype=np.float32,
+                        progress=lambda msg: progress(f"{cell}: {msg}"))
+
+                eng = make_engine()
+                verdicts = []
+                start = (eng.last_date + 1 if eng.last_date is not None
+                         else 0)
+                for t in range(start, d):
+                    if is_kill and t == kill_resume_at and start == 0:
+                        # deterministic in-process restart mid-stream
+                        # (both the clean and the killed CLI runs take
+                        # it, so their streams stay identical)
+                        eng = make_engine()
+                    fac, uni = None, None
+                    if anomaly == "nan_storm" and t == anomaly_at:
+                        fac = factors.copy()
+                        storm = fac[:, t, :]
+                        storm[np.random.default_rng(seed).uniform(
+                            size=storm.shape) < 0.9] = np.nan
+                    if anomaly == "universe_collapse" and t == anomaly_at:
+                        uni = universe.copy()
+                        uni[t, 1:] = False
+                    verdicts.append(eng.ingest(t, slice_at(t, fac, uni)))
+                # the anomaly's extra tick (ordering/restatement classes)
+                if anomaly == "late_date":
+                    verdicts.append(eng.ingest(-1, slice_at(0)))
+                elif anomaly == "duplicate_date":
+                    verdicts.append(eng.ingest(d - 1, slice_at(d - 1)))
+                elif anomaly == "restated_date":
+                    fac = factors.copy()
+                    fac[:, restate_of, :] = np.where(
+                        np.isnan(fac[:, restate_of, :]),
+                        np.nan, fac[:, restate_of, :] * 1.5)
+                    verdicts.append(eng.ingest(restate_of,
+                                               slice_at(restate_of, fac),
+                                               restate=True))
+                elif anomaly == "kill_after_apply":
+                    # exactly-once proof: re-feeding the last applied
+                    # date must reject as a duplicate, not double-apply
+                    verdicts.append(eng.ingest(d - 1, slice_at(d - 1)))
+
+                violations = []
+                if not eng.verdict_complete():
+                    violations.append(
+                        f"verdict counts do not sum to ingestions: "
+                        f"{eng.counters}")
+                expect = ONLINE_EXPECT.get((anomaly, pol_name))
+                if expect is not None:
+                    got = verdicts[-1] if anomaly != "nan_storm" and \
+                        anomaly != "universe_collapse" else \
+                        verdicts[anomaly_at - start]
+                    if (got.status, got.reason) != expect and \
+                            (got.status, None) != expect:
+                        violations.append(
+                            f"anomaly tick verdict ({got.status}, "
+                            f"{got.reason}) != expected {expect}")
+                violations.extend(check_rows(verdicts))
+                # statuses derive from the engine's GLOBAL counters, not
+                # the verdicts this process saw: a killed-and-resumed
+                # cell's stdout must be byte-equal to a straight-through
+                # run's, and only the engine's resumed tallies are
+                statuses = {"applied": eng.counters["applied_dates"],
+                            "replayed": eng.counters["replayed_dates"],
+                            "rejected": eng.counters["rejected_dates"]}
+                result = {
+                    "anomaly": anomaly, "policy": pol_name,
+                    "ok": not violations, "violations": violations,
+                    "statuses": statuses,
+                    "counters": {k: int(v)
+                                 for k, v in sorted(eng.counters.items())},
+                    "rejected_reasons": dict(sorted(
+                        eng.rejected_reasons.items())),
+                    # the canonical content hash (resil.checkpoint's
+                    # fingerprint) — byte-equal state across a clean run
+                    # and a killed-and-resumed one is the cell's whole
+                    # claim
+                    "state_digest": fingerprint(
+                        *jax.tree_util.tree_leaves(eng._state)),
+                    "chain": eng._chain[:16],
+                }
+                rep.record(f"chaos/{cell}", kind="online",
+                           **eng.report_fields())
+                progress(f"{cell}: "
+                         f"{'ok' if result['ok'] else 'FAIL'} "
+                         f"(statuses={statuses})")
+                loop.complete(idx, cell, result)
+    finally:
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
+
+    return loop.verdict(cells)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -701,10 +954,18 @@ def main(argv=None) -> int:
                              "the matrix presets")
     parser.add_argument("--paths", type=int, default=6,
                         help="scenario paths per cell (with --scenarios)")
+    parser.add_argument("--online", action="store_true",
+                        help="run the ONLINE preset: feed-anomaly x "
+                             "engine-guard cells over the online-advance "
+                             "state machine — verdict completeness, "
+                             "explicit rejections, restatement replay, "
+                             "checkpoint kill/resume (module docs). "
+                             "--faults selects anomalies, --policies "
+                             "open/guarded")
     args = parser.parse_args(argv)
-    if args.serving and args.scenarios:
-        print("chaos: --serving and --scenarios are mutually exclusive",
-              file=sys.stderr)
+    if sum((args.serving, args.scenarios, args.online)) > 1:
+        print("chaos: --serving, --scenarios, and --online are mutually "
+              "exclusive", file=sys.stderr)
         return 2
 
     try:
@@ -725,14 +986,23 @@ def main(argv=None) -> int:
 
     from factormodeling_tpu import obs
 
-    rep = obs.RunReport("chaos-scenarios" if args.scenarios
+    rep = obs.RunReport("chaos-online" if args.online
+                        else "chaos-scenarios" if args.scenarios
                         else "chaos-serving" if args.serving else "chaos")
     faults = None if args.faults == "all" else args.faults.split(",")
     policies = None if args.policies == "all" else args.policies.split(",")
     from factormodeling_tpu.resil import SnapshotCorrupt
 
     try:
-        if args.scenarios:
+        if args.online:
+            verdict = run_online_chaos(
+                shape=shape, window=args.window, method=args.method,
+                faults=faults, policies=policies, seed=args.seed,
+                tol=args.tol, report=rep,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                progress=lambda msg: print(msg, file=sys.stderr))
+        elif args.scenarios:
             verdict = run_scenario_chaos(
                 shape=shape, window=args.window, method=args.method,
                 families=faults, policies=policies, n_paths=args.paths,

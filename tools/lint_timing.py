@@ -245,13 +245,20 @@ def default_targets(repo_root=None) -> list[Path]:
     loop is exactly the shape where an ad-hoc paths/s window would be
     tempting and wrong (the vmapped dispatch returns before a single
     path has computed — the bench's fenced harness is the only sound
-    way to time it), pinned by name in tests/test_lint_timing.py."""
+    way to time it), pinned by name in tests/test_lint_timing.py. The
+    online-advance package (round 17) joins by its own glob: the engine
+    is a per-date LATENCY-claiming host loop (its advance p99 is the
+    product's SLO surface, published only through the bench's fenced
+    sketches), exactly where an unfenced "time one ingest" window would
+    be tempting and would time async dispatch — pinned by name in
+    tests/test_lint_timing.py."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))
             + sorted((root / "examples").glob("*.py"))
             + sorted((pkg / "backtest").glob("*.py"))
             + sorted((pkg / "obs").glob("*.py"))
+            + sorted((pkg / "online").glob("*.py"))
             + sorted((pkg / "ops").glob("_pallas_*.py"))
             + sorted((pkg / "resil").glob("*.py"))
             + sorted((pkg / "scenarios").glob("*.py"))

@@ -23,7 +23,13 @@ traffic (``kind="serving"`` rows, round 15), a scenario risk row whose
 VaR/ES worsened beyond the ratio + the baseline's recorded spread or
 went non-finite (``kind="scenario"`` rows, round 16 — gated even under
 ``--no-wall``: scenario sweeps are seeded-deterministic, a risk
-worsening is never machine speed), or a seconds-valued
+worsening is never machine speed), an online-advance engine whose
+``rejected_dates`` / ``replayed_dates`` / ``full_recompute_fallbacks``
+grew against the same recorded feed or whose verdict counts no longer
+sum to its ingestions (``kind="online"`` rows, round 17 — armed under
+``--no-wall``, and the ``online/*`` / ``bench/online_advance`` latency
+scopes keep their count-aware p50/p99 ratio gate armed there too: the
+advance p99 is the product's own SLO surface), or a seconds-valued
 bench row beyond the ratio AND the baseline's recorded best-of-N spread
 — throughput rows with ANY ``/s`` unit (``configs/s``, ``paths/s``)
 gate on drops through the same clause —

@@ -9,15 +9,19 @@ they fenced); counters, the solver section (scheme + Anderson-acceleration
 telemetry), numerics probes, compile telemetry, the placement ledger
 (comms / device memory / sharding lint), latency sketches (per-scope
 count + p50/p90/p99 + SLO verdict), the serving queue (verdict counts —
-served/shed/miss/failed must sum to submissions), device-time
+served/shed/miss/failed must sum to submissions), the online-advance
+engine (verdict counts — applied/replayed/rejected must sum to
+ingestions, plus rejection reasons and the full-recompute fallback
+tally), device-time
 attribution, cost-analysis estimates, bench rows, and plain stage
 records print in their own sections. Pure stdlib — usable on any box that has the JSONL, no jax
 required.
 
 Exit codes: 0 = rendered (``--strict`` turns unsound spans, sharding-lint
-flags, SLO violations, and malformed latency/devtime/serving/scenario
-rows (a scenario risk row with non-finite VaR/ES fails strict) — a
-serving row whose verdict counts do not sum to its submissions — into 1);
+flags, SLO violations, and malformed latency/devtime/serving/scenario/
+online rows (a scenario risk row with non-finite VaR/ES fails strict) — a
+serving row whose verdict counts do not sum to its submissions, an
+online row whose verdicts do not sum to its ingestions — into 1);
 2 = unusable input (missing/unreadable file, or no parseable rows at all
 — empty or fully corrupt). A truncated tail — a run killed mid-write — is
 skipped with a file:line warning and the surviving rows still render:
@@ -417,6 +421,43 @@ def _serving_table(rows) -> str | None:
                           "extra"), body))
 
 
+#: must sum to ``ingested_dates`` — the online engine's completeness
+#: contract, checked by ``--strict`` (malformed_rows)
+_ONLINE_VERDICT_KEYS = ("applied_dates", "replayed_dates",
+                        "rejected_dates")
+_ONLINE_INT_KEYS = _ONLINE_VERDICT_KEYS + (
+    "ingested_dates", "replay_applied_dates", "full_recompute_fallbacks")
+
+
+def _online_table(rows) -> str | None:
+    on = [r for r in rows if r.get("kind") == "online"]
+    if not on:
+        return None
+    last: dict[str, dict] = {}
+    for r in on:
+        last[r.get("name", "?")] = r
+
+    def g(r, key):
+        v = r.get(key)
+        return v if isinstance(v, (int, float)) else "-"
+
+    body = []
+    for name, r in sorted(last.items()):
+        reasons = r.get("rejected_reasons") or {}
+        reason_s = " ".join(f"{k}={v}" for k, v in sorted(reasons.items()))
+        body.append((name, g(r, "ingested_dates"), g(r, "applied_dates"),
+                     g(r, "replayed_dates"), g(r, "rejected_dates"),
+                     g(r, "replay_applied_dates"),
+                     g(r, "full_recompute_fallbacks"),
+                     g(r, "last_date"), g(r, "state_version"),
+                     reason_s or "-"))
+    return ("== online advance (verdict counts; "
+            "applied+replayed+rejected must equal ingested) ==\n"
+            + _fmt_table(("engine", "ingested", "applied", "replayed",
+                          "rejected", "replay_applied", "full_recompute",
+                          "last_date", "version", "reasons"), body))
+
+
 def _scenario_table(rows) -> str | None:
     sc = [r for r in rows if r.get("kind") == "scenario"]
     if not sc:
@@ -450,7 +491,7 @@ def _stage_table(rows) -> str | None:
                                        "numerics", "watchdog", "compile",
                                        "comms", "memory", "sharding",
                                        "latency", "devtime", "serving",
-                                       "scenario", "meta")]
+                                       "scenario", "online", "meta")]
     if not stages:
         return None
     body = []
@@ -495,7 +536,7 @@ def render(rows) -> str:
              "device_count", "mesh_shape") if meta.get(k) is not None))
     sections = [head]
     for maker in (_span_table, _latency_table, _serving_table,
-                  _scenario_table, _counter_table, _solver_table,
+                  _online_table, _scenario_table, _counter_table, _solver_table,
                   _numerics_table, _watchdog_table, _compile_table,
                   _comms_table, _memory_table, _sharding_table,
                   _devtime_table, _cost_table, _bench_table, _stage_table):
@@ -537,15 +578,18 @@ def slo_violations(rows) -> list[str]:
 
 
 def malformed_rows(rows) -> list[str]:
-    """Descriptions of latency/devtime/serving/scenario rows missing
-    their contract fields — strict validation of the PR 9/15/16 row
-    kinds. A latency row must carry a count and (when non-empty) finite
+    """Descriptions of latency/devtime/serving/scenario/online rows
+    missing their contract fields — strict validation of the PR 9/15/16/17
+    row kinds. A latency row must carry a count and (when non-empty) finite
     p50/p99; a devtime row must carry device seconds OR an honest
     skip/error reason; a serving row must carry non-negative integer
     verdict counts that SUM to its submissions — the queue's completeness
     contract, judged from the artifact alone; a scenario risk row with
     folded paths must carry FINITE VaR/ES at every level (a NaN/Inf risk
-    number is a broken sweep, never a publishable tail)."""
+    number is a broken sweep, never a publishable tail); an online
+    engine row must carry non-negative integer verdict counts that SUM
+    to its ingestions — the exactly-once completeness contract, judged
+    from the artifact alone."""
     bad = []
     for r in rows:
         kind = r.get("kind")
@@ -589,6 +633,22 @@ def malformed_rows(rows) -> list[str]:
                     f"serving row {name!r}: verdict counts sum {total} "
                     f"!= submitted {vals['submitted']} — a request was "
                     f"silently dropped or double-counted")
+        elif kind == "online":
+            name = r.get("name", "?")
+            vals = {k: r.get(k) for k in _ONLINE_INT_KEYS}
+            broken = [k for k, v in vals.items()
+                      if not isinstance(v, int) or isinstance(v, bool)
+                      or v < 0]
+            if broken:
+                bad.append(f"online row {name!r}: missing/invalid "
+                           f"count(s) {broken}")
+                continue
+            total = sum(vals[k] for k in _ONLINE_VERDICT_KEYS)
+            if total != vals["ingested_dates"]:
+                bad.append(
+                    f"online row {name!r}: verdict counts sum {total} "
+                    f"!= ingested {vals['ingested_dates']} — a date "
+                    f"terminated in zero or two verdicts")
         elif kind == "latency":
             n = r.get("count")
             if not isinstance(n, int) or n < 0:
