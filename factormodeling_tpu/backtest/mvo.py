@@ -49,6 +49,7 @@ from jax import lax
 from factormodeling_tpu.backtest.diagnostics import SchemeStats
 from factormodeling_tpu.backtest.settings import SimulationSettings
 from factormodeling_tpu.backtest.weights import equal_weights, leg_masks
+from factormodeling_tpu.ops import _assetspec
 from factormodeling_tpu.solvers import (ADMMWarmState, BoxQPProblem,
                                         admm_solve_lowrank)
 from factormodeling_tpu.solvers.portfolio import (
@@ -315,6 +316,11 @@ def mvo_weights(signal: jnp.ndarray, s: SimulationSettings):
     import jax
 
     d, n = signal.shape
+    # asset-sharded N: the dense [D, N] operand feeding the vmapped day
+    # solves routes through the spec-plan seam — "auto" keeps the dense
+    # [N] iterates asset-sharded, "reshard" re-lays day-sharded (whole
+    # solves device-local), identity with no active plan
+    signal = _assetspec.hint(signal, "solver/iterates")
     pos, neg, flat = leg_masks(signal)
     stacks = _risk_model_stack(s) if s.covariance == "risk_model" else None
     dtype = s.returns.dtype
@@ -409,6 +415,7 @@ def mvo_turnover_weights(signal: jnp.ndarray, s: SimulationSettings):
       sequential fallback for the unconverged suffix
       (:func:`_mvo_turnover_parallel`).
     """
+    signal = _assetspec.hint(signal, "solver/iterates")
     if s.turnover_mode == "parallel":
         return _mvo_turnover_parallel(signal, s)
     return _mvo_turnover_scan(signal, s)

@@ -11,6 +11,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from factormodeling_tpu.ops import _assetspec
+
 __all__ = ["leg_masks", "equal_weights", "linear_weights",
            "normalize_legs", "cap_and_redistribute"]
 
@@ -45,6 +47,9 @@ def _asc_rank(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     the documented divergence at :func:`_desc_rank` covers BOTH legs; the
     stable rule here is the deterministic contract."""
     keyed = jnp.where(mask, values, jnp.inf)
+    # asset-sharded N: the leg-rank sorts route through the spec-plan seam
+    # (identity with no active plan — ops/_assetspec.py)
+    keyed = _assetspec.hint(keyed, "backtest/weights")
     order = jnp.argsort(keyed, axis=_N_AXIS, stable=True)
     return jnp.argsort(order, axis=_N_AXIS, stable=True)
 
@@ -61,6 +66,7 @@ def _desc_rank(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     first-index rule (the same one pandas ``nlargest`` documents) so the
     selection is deterministic across runs and numpy versions."""
     keyed = jnp.where(mask, values, -jnp.inf)
+    keyed = _assetspec.hint(keyed, "backtest/weights")
     order = jnp.argsort(-keyed, axis=_N_AXIS, stable=True)
     return jnp.argsort(order, axis=_N_AXIS, stable=True)
 

@@ -83,8 +83,16 @@ def _rank_ic(f: jnp.ndarray, r: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
 
     from jax import lax
 
+    from factormodeling_tpu.ops import _assetspec
+
     key = jnp.where(valid, f, jnp.nan)
     rr = jnp.broadcast_to(jnp.where(valid, r, 0.0), key.shape)
+    # asset-sharded mesh: this sort is the pipeline's dominant data mover,
+    # so its layout (reshard-to-batch-dim vs gather) is the ledger-chosen
+    # spec the asset-axis scale-out pins (parallel/asset_shard.py §24);
+    # with no active plan the hints are identity and nothing is traced
+    key = _assetspec.hint(key, "metrics/rank_ic")
+    rr = _assetspec.hint(rr, "metrics/rank_ic")
 
     n = key.shape[-1]
     from factormodeling_tpu.metrics import _pallas_rank_ic as _pri

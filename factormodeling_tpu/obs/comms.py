@@ -318,16 +318,23 @@ class CommsLedger:
 
     def by_stage(self) -> dict:
         """stage -> {"collectives": {kind: {count, bytes_moved}},
-        "bytes_moved": total} in first-appearance order."""
+        "bytes_moved": total, "by_axis": {axis: bytes}} in
+        first-appearance order. The per-stage ``by_axis`` split (round 18)
+        is what lets ``report_diff`` gate an ASSET-axis byte blowup in one
+        stage even when another stage's date-axis traffic shrank enough to
+        hide it in the stage total."""
         out: dict = {}
         for op in self.ops:
             bucket = out.setdefault(op.stage,
-                                    {"collectives": {}, "bytes_moved": 0.0})
+                                    {"collectives": {}, "bytes_moved": 0.0,
+                                     "by_axis": {}})
             k = bucket["collectives"].setdefault(
                 op.kind, {"count": 0, "bytes_moved": 0.0})
             k["count"] += 1
             k["bytes_moved"] += op.bytes_moved
             bucket["bytes_moved"] += op.bytes_moved
+            bucket["by_axis"][op.axis] = (bucket["by_axis"].get(op.axis, 0.0)
+                                          + op.bytes_moved)
         return out
 
     def totals(self) -> dict:

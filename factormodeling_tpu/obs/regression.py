@@ -35,6 +35,10 @@ flags):
   data it didn't), and ``bytes_moved`` growth beyond ``comms_ratio`` with
   at least ``comms_min_bytes`` of absolute growth is a regression; byte
   shrinkage and brand-new ledger rows are notes (re-baseline to gate).
+  Rows carrying a ``by_axis`` split (round 18 — every per-stage row does)
+  additionally gate PER MESH AXIS under the same ratio + floor, keyed on
+  the ledger's stage names: an asset-axis byte blowup in one stage cannot
+  hide behind another axis's shrinkage in that stage's total.
 - **memory** — per entry point, ``peak_bytes`` growth beyond
   ``mem_ratio`` with at least ``mem_min_bytes`` absolute growth is a
   regression; a vanished memory row is a schema regression.
@@ -538,6 +542,33 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
                 "comms", label,
                 f"estimated comms bytes {b_bytes:.4g} -> {n_bytes:.4g} "
                 f"(improvement or restructure — re-baseline to gate it)"))
+        # per-axis worsening (round 18, keyed on the ledger's stage names
+        # through `label`): an ASSET-axis byte blowup in one stage must
+        # not hide behind another axis's shrinkage in the stage total.
+        # A baseline WITHOUT a by_axis split (pre-round-18 artifact)
+        # cannot gate — every axis would read 0 -> N on a byte-identical
+        # program — so that case is a re-baseline note, not a regression.
+        base_ax = base_row.get("by_axis") or {}
+        new_ax = new_row.get("by_axis") or {}
+        if not base_ax and new_ax:
+            findings.append(Finding(
+                "comms", label,
+                "per-axis byte split absent from baseline (pre-round-18 "
+                "report) — re-baseline to arm the per-axis gate"))
+            continue
+        for axis in sorted(set(base_ax) | set(new_ax)):
+            b_ax = float(base_ax.get(axis, 0.0))
+            n_ax = float(new_ax.get(axis, 0.0))
+            ax_growth = n_ax - b_ax
+            if ax_growth > comms_min_bytes and (
+                    b_ax <= 0 or n_ax / b_ax > comms_ratio):
+                findings.append(Finding(
+                    "comms", f"{label}/axis:{axis}",
+                    f"bytes over mesh axis {axis!r} {b_ax:.4g} -> "
+                    f"{n_ax:.4g} (+{ax_growth:.4g}, > {comms_ratio:g}x "
+                    f"tolerance) — this stage's layout started moving "
+                    f"data over an axis it barely touched",
+                    regression=True))
     for (name, stage) in sorted(set(new_cm) - set(base_cm)):
         findings.append(Finding(
             "comms", f"{name}/{stage}",

@@ -1,0 +1,147 @@
+"""Asset-axis layout plan for sort-heavy kernels (inactive by default).
+
+When the asset axis ``N`` is sharded across a device mesh
+(``parallel/asset_shard.py``), the SPMD partitioner must pick a layout for
+every sort/quantile ALONG that axis — GSPMD has no distributed sort, so a
+sort over a sharded dimension forces data movement one way or another:
+reshard the operand so the sort dimension is device-local (an all-to-all
+that moves ``(S-1)/S`` of the operand per participant), or gather it (an
+all-gather that moves ``S-1`` local shards per participant and then
+replicates the whole sort). Which is cheaper depends on the operand's
+batch dims and on what the surrounding stages need — it is a measurable
+choice, and the placement ledger (:mod:`factormodeling_tpu.obs.comms`)
+prices each candidate in predicted bytes moved.
+
+This module is the seam the ledger-driven chooser acts through:
+
+- :class:`AssetSpecPlan` maps a sort-site stage name to a layout mode
+  (``"auto"`` — leave the partitioner alone, ``"reshard"`` — constrain the
+  operand so the mesh axis sits on its largest batch dim, ``"gather"`` —
+  constrain it fully replicated).
+- :func:`plan` installs a plan for the duration of a trace; the sort-heavy
+  kernels (``ops/_rank.py``, ``metrics/factor_metrics._rank_ic``,
+  ``backtest/weights``' leg ranks) call :func:`hint` on their sort
+  operands.
+- With no plan installed (the default, and every pre-round-18 caller)
+  :func:`hint` is IDENTITY and nothing is traced — structural elision in
+  the repo's usual sense, pinned in ``tests/test_asset_sharding.py``.
+
+The plan deliberately binds by STAGE NAME, not call site: the chooser
+(``parallel/asset_shard.choose_asset_specs``) compiles one candidate per
+(stage, mode), ranks them by the ledger's predicted bytes, and pins the
+winner — see docs/architecture.md §24.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["ASSET_SORT_STAGES", "AssetSpecPlan", "active_plan", "hint",
+           "plan"]
+
+#: the sort-site stage names the research pipeline routes through this
+#: seam — the keys an AssetSpecPlan's ``modes`` may bind, and the stages
+#: the spec chooser enumerates. (``ops/rank`` covers cs_rank and the
+#: blend's rank transform; ``ops/quantile`` covers winsor/filter_center
+#: and the blend's pooled percentiles; ``backtest/weights`` covers the
+#: leg-selection ranks of every weight scheme; ``solver/iterates`` covers
+#: the batched ADMM QP's dense ``[B, N]`` day-chunk operands — not a sort
+#: site, but the same layout decision: "auto" leaves the dense ``[N]``
+#: iterates asset-sharded as the panels arrive, "reshard" re-lays the
+#: chunk day-sharded (each device owns whole per-day solves, ``N``
+#: local), "gather" replicates — the risk-model low-rank factors stay
+#: replicated either way.)
+ASSET_SORT_STAGES = ("metrics/rank_ic", "ops/rank", "ops/quantile",
+                     "backtest/weights", "solver/iterates")
+
+_MODES = ("auto", "reshard", "gather")
+
+_PLAN = None
+
+
+class AssetSpecPlan:
+    """One layout decision per sort-site stage (module docs).
+
+    Args:
+      mesh: the ``jax.sharding.Mesh`` carrying the asset axis.
+      axis: the mesh axis name the asset dimension is sharded over.
+      modes: ``{stage: mode}`` — stages not listed use ``default``.
+      default: mode for unlisted stages (``"auto"``).
+    """
+
+    def __init__(self, mesh, axis: str = "assets", modes=None,
+                 default: str = "auto"):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no {axis!r} axis "
+                             f"(axes: {mesh.axis_names})")
+        self.mesh = mesh
+        self.axis = axis
+        self.modes = dict(modes or {})
+        for stage, mode in self.modes.items():
+            if mode not in _MODES:
+                raise ValueError(f"unknown asset-spec mode {mode!r} for "
+                                 f"stage {stage!r} (expected one of "
+                                 f"{_MODES})")
+        if default not in _MODES:
+            raise ValueError(f"unknown default mode {default!r}")
+        self.default = default
+
+    def mode_for(self, stage: str) -> str:
+        return self.modes.get(stage, self.default)
+
+    def constrain(self, x, stage: str, sort_dim: int):
+        """Apply the stage's layout constraint to one sort operand.
+        ``"auto"`` touches nothing (no constraint traced)."""
+        mode = self.mode_for(stage)
+        if mode == "auto":
+            return x
+        from jax.lax import with_sharding_constraint
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        ndim = x.ndim
+        sort_dim = sort_dim % ndim
+        dims = [None] * ndim
+        if mode == "reshard":
+            # mesh axis onto the largest batch dim: the sort dimension
+            # stays device-local and the move is one all-to-all
+            batch = [d for d in range(ndim) if d != sort_dim]
+            if not batch:  # a 1-D operand has nowhere to reshard to
+                return with_sharding_constraint(
+                    x, NamedSharding(self.mesh, PartitionSpec()))
+            dims[max(batch, key=lambda d: x.shape[d])] = self.axis
+        # "gather": all dims None == fully replicated
+        return with_sharding_constraint(
+            x, NamedSharding(self.mesh, PartitionSpec(*dims)))
+
+    def spec_table(self) -> dict:
+        """``{stage: mode}`` over :data:`ASSET_SORT_STAGES` (report
+        surface — what the weak-scaling rows and spec_choice rows
+        record)."""
+        return {s: self.mode_for(s) for s in ASSET_SORT_STAGES}
+
+
+def active_plan():
+    return _PLAN
+
+
+@contextmanager
+def plan(p: AssetSpecPlan | None):
+    """Install ``p`` as the active plan while tracing (None = deactivate).
+    The plan must be active AT TRACE TIME — wrap the traced function body,
+    not the dispatch (``parallel/asset_shard.py`` does this for the
+    research step)."""
+    global _PLAN
+    prev, _PLAN = _PLAN, p
+    try:
+        yield p
+    finally:
+        _PLAN = prev
+
+
+def hint(x, stage: str, *, sort_dim: int = -1):
+    """Constrain a sort/quantile operand to the active plan's layout for
+    ``stage``; IDENTITY when no plan is active (nothing traced — the
+    pre-round-18 HLO is byte-identical, pinned)."""
+    if _PLAN is None:
+        return x
+    return _PLAN.constrain(x, stage, sort_dim)

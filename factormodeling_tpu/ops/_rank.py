@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from factormodeling_tpu.ops import _assetspec
+
 __all__ = ["avg_rank", "masked_quantile", "rank_sorted", "segment_avg_rank",
            "sorted_avg_ranks"]
 
@@ -78,6 +80,7 @@ def segment_avg_rank(values: jnp.ndarray, seg_ids: jnp.ndarray, *, axis: int = -
     _check_method(method)
     axis = axis % values.ndim
     n = values.shape[axis]
+    values = _assetspec.hint(values, "ops/rank", sort_dim=axis)
     shape = [1] * values.ndim
     shape[axis] = n
     ar = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32).reshape(shape), values.shape)
@@ -198,6 +201,9 @@ def rank_sorted(values: jnp.ndarray, *, axis: int = -1, carry=(),
     _check_method(method)
     axis = axis % values.ndim
     n = values.shape[axis]
+    # asset-sharded sort axis: the active AssetSpecPlan (if any) decides
+    # reshard-vs-gather here; no plan = identity (ops/_assetspec.py)
+    values = _assetspec.hint(values, "ops/rank", sort_dim=axis)
     # canonicalize NaN sign: XLA total order sorts -NaN first but +NaN last
     key = jnp.where(jnp.isnan(values), jnp.nan, values)
     operands = (key,) + tuple(jnp.broadcast_to(c, values.shape) for c in carry)
@@ -248,6 +254,7 @@ def avg_rank(values: jnp.ndarray, *, axis: int = -1, method: str = "average",
     _check_method(method)
     axis = axis % values.ndim
     n = values.shape[axis]
+    values = _assetspec.hint(values, "ops/rank", sort_dim=axis)
     shape = [1] * values.ndim
     shape[axis] = n
     ar = jnp.arange(n, dtype=jnp.int32).reshape(shape)
@@ -279,6 +286,7 @@ def masked_quantile(values: jnp.ndarray, qs, *, axis: int = -1) -> jnp.ndarray:
     """
     axis = axis % values.ndim
     n = values.shape[axis]
+    values = _assetspec.hint(values, "ops/quantile", sort_dim=axis)
     qs_arr = jnp.atleast_1d(jnp.asarray(qs, dtype=values.dtype))
     valid = ~jnp.isnan(values)
     cnt = valid.sum(axis=axis, keepdims=True).astype(values.dtype)

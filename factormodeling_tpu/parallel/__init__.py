@@ -16,12 +16,20 @@ and ``jit`` / ``shard_map`` let XLA lower the cross-shard reductions
 (``psum``/halo exchanges for rolling windows) onto ICI.
 """
 
+from factormodeling_tpu.parallel.asset_shard import (  # noqa: F401
+    AssetSpecPlan,
+    choose_asset_specs,
+    make_asset_mesh,
+    make_asset_sharded_research_step,
+    record_spec_choices,
+)
 from factormodeling_tpu.parallel.cluster import (  # noqa: F401
     initialize_cluster,
     make_hybrid_mesh,
     num_slices,
 )
 from factormodeling_tpu.parallel.mesh import (  # noqa: F401
+    ASSET_AXIS,
     balanced_mesh_shape,
     make_mesh,
     panel_sharding,

@@ -15,7 +15,10 @@ dryrun_multichip`` (the driver's multi-chip validation).
 
 Worker entry: ``python -m factormodeling_tpu.parallel._dist_check <rank>
 <port> [<n_proc> <local_devices>]`` (the launcher always passes all four)
-— prints ``DIST_OK <rank>`` on success.
+— prints ``DIST_OK <rank>`` after the factor/date-mesh check and
+``DIST_ASSET_OK <rank>`` after the round-18 asset-mesh leg (a
+``("date", "assets")`` hybrid mesh through the same bring-up); the
+launcher requires both.
 """
 
 from __future__ import annotations
@@ -118,6 +121,27 @@ def worker(rank: int, port: int, n_proc: int = _NPROC,
                - float(local.summary.sharpe)) < 1e-8
     print(f"DIST_OK {rank}", flush=True)
 
+    # asset-axis leg (round 18): the SAME bring-up serves the
+    # asset-sharded step on a ("date", "assets") hybrid mesh — dates span
+    # DCN (near-embarrassingly parallel), the sort-heavy asset axis stays
+    # inside a slice on ICI (the cluster.py placement rule restated for
+    # the scale-out axis)
+    from factormodeling_tpu.parallel import make_asset_sharded_research_step
+
+    amesh = make_hybrid_mesh(("date", "assets"))
+    assert amesh.devices.size == n_proc * local_devices
+    astep, ashard = make_asset_sharded_research_step(amesh, **cfg)
+    asharded = astep(*ashard(*raw))
+    for name, got_g, exp in (
+            ("asset_selection", asharded.selection, local.selection),
+            ("asset_signal", asharded.signal, local.signal),
+            ("asset_log_return", asharded.sim.result.log_return,
+             local.sim.result.log_return)):
+        got = multihost_utils.process_allgather(got_g, tiled=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   atol=1e-10, equal_nan=True, err_msg=name)
+    print(f"DIST_ASSET_OK {rank}", flush=True)
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -171,7 +195,8 @@ def launch(timeout: float = 420.0, n_proc: int = _NPROC,
     # report the worker that crashed on its own (a killed survivor's rc=-9
     # is a symptom, not the diagnosis)
     failed = [(r, p2, out) for r, (p2, out) in enumerate(zip(procs, outs))
-              if p2.returncode != 0 or f"DIST_OK {r}" not in out]
+              if p2.returncode != 0 or f"DIST_OK {r}" not in out
+              or f"DIST_ASSET_OK {r}" not in out]
     if failed:
         failed.sort(key=lambda t: (t[1].returncode is None
                                    or t[1].returncode < 0))

@@ -1,0 +1,358 @@
+"""Asset-axis scale-out: the asset-sharded research step and the
+ledger-driven ``PartitionSpec`` chooser (docs/architecture.md §24).
+
+Every scaling artifact before round 18 shards the factor/config/path axes
+and replicates the asset axis ``N`` everywhere — fine at N=512, hopeless
+at a 10k+ name universe where the ``[D, N]`` panels and the MVO worksets
+stop fitting a replicated layout. This module makes ``N`` a first-class
+sharded mesh dimension end-to-end:
+
+- :func:`make_asset_mesh` builds the mesh (default a flat ``("assets",)``
+  mesh; serving uses ``("configs", "assets")``, multi-host routes the
+  same axis names through ``cluster.make_hybrid_mesh``).
+- :func:`make_asset_sharded_research_step` is the
+  ``make_sharded_research_step`` sibling with the asset axis on every
+  ``[..., N]`` operand. Elementwise panels and the IC/ICIR reductions
+  partition for free (partial-reduce + a small all-reduce, inserted by
+  GSPMD); the SORT-heavy cross-sectional kernels do not — GSPMD has no
+  distributed sort, so a sort along a sharded dimension forces a layout
+  decision at every sort site. Those sites route through the
+  :mod:`factormodeling_tpu.ops._assetspec` plan seam, and the step
+  installs an :class:`AssetSpecPlan` AT TRACE TIME so the plan's
+  per-stage mode (``auto`` / ``reshard`` / ``gather``) becomes a traced
+  ``with_sharding_constraint``.
+- :func:`choose_asset_specs` is the ledger-driven chooser: compile one
+  candidate per mode (abstract lowering — no data moves), read the
+  placement ledger's per-stage and per-axis byte totals
+  (:func:`factormodeling_tpu.obs.comms.comms_ledger`), rank each stage's
+  modes by predicted bytes moved, and return the winning plan plus the
+  ranking table. :func:`record_spec_choices` lands the result as
+  ``kind="spec_choice"`` report rows — ``tools/trace_report.py --strict``
+  rejects a row whose ``chosen`` disagrees with the ledger's ranked
+  ``winner``, so a hand-pinned spec that the ledger says moves more
+  bytes fails CI from the artifact alone.
+
+Honest attribution limits: the chooser compiles UNIFORM plans (all
+stages in one mode per candidate) and attributes each stage's bytes via
+the ``obs.stage`` scopes its collectives land under
+(:data:`_STAGE_LEDGER_SCOPES`). Stages whose sort sites share a scope
+(the blend's rank transform and its pooled quantiles both trace under
+``composite/blend``) therefore rank identically — a shared-scope tie,
+not an error — and collectives the partitioner hoists outside any scope
+fall back to the candidate's TOTAL bytes. The byte model itself is the
+ledger's (indicative ring/butterfly factors, topology-blind); on this
+CPU container the numbers are predictions of what a real ICI mesh would
+move, which is exactly what makes them comparable across candidates.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from factormodeling_tpu.obs import comms as obs_comms
+from factormodeling_tpu.obs import record_stage
+from factormodeling_tpu.obs.compile_log import entry_point_tag, instrument_jit
+from factormodeling_tpu.ops._assetspec import (
+    ASSET_SORT_STAGES,
+    _MODES,
+    AssetSpecPlan,
+    plan as install_plan,
+)
+from factormodeling_tpu.parallel.mesh import (ASSET_AXIS, make_mesh,
+                                              panel_sharding, stack_sharding)
+from factormodeling_tpu.parallel.pipeline import build_research_step
+
+__all__ = [
+    "ASSET_SORT_STAGES",
+    "AssetSpecPlan",
+    "asset_in_shardings",
+    "choose_asset_specs",
+    "make_asset_mesh",
+    "make_asset_sharded_research_step",
+    "record_spec_choices",
+]
+
+#: the obs.stage ledger scopes each plan stage's collectives land under
+#: (module docs: uniform-plan attribution; shared scopes rank together)
+_STAGE_LEDGER_SCOPES = {
+    # the rank-IC sort runs inside rolling_selection, so its collectives
+    # attribute to the OUTERMOST scope (selection/rolling) — shared with
+    # ops/rank's selection-side sorts: those two stages rank together by
+    # construction (the module-docs shared-scope tie)
+    "metrics/rank_ic": ("metrics/rank_ic", "selection/daily_stats",
+                        "selection/rolling"),
+    "ops/rank": ("selection/rolling", "selection/rolling_metrics",
+                 "composite/blend"),
+    "ops/quantile": ("composite/blend",),
+    "backtest/weights": ("backtest/weights", "backtest/trade_list"),
+    "solver/iterates": ("solver/admm", "solver/polish"),
+}
+
+
+def make_asset_mesh(axis_names: tuple[str, ...] = (ASSET_AXIS,),
+                    n_devices: int | None = None, devices=None) -> Mesh:
+    """A mesh carrying the asset axis: flat ``("assets",)`` by default,
+    or any axis tuple containing :data:`~factormodeling_tpu.parallel.
+    mesh.ASSET_AXIS` (the serving layer's ``("configs", "assets")``)."""
+    if ASSET_AXIS not in axis_names:
+        raise ValueError(f"axis_names {axis_names} carry no "
+                         f"{ASSET_AXIS!r} axis")
+    return make_mesh(axis_names, n_devices=n_devices, devices=devices)
+
+
+def asset_in_shardings(mesh: Mesh, date_axis: str | None = None,
+                       asset_axis: str = ASSET_AXIS) -> tuple:
+    """The research step's declared input shardings under an asset mesh:
+    ``factors [F, D, N]`` and the ``[D, N]`` panels carry the asset axis
+    on ``N`` (plus the date axis when the mesh has one); ``factor_ret
+    [D, F]`` never touches ``N`` and shards dates only."""
+    if asset_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {asset_axis!r} axis "
+                         f"(axes: {mesh.axis_names})")
+    if date_axis is not None and date_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {date_axis!r} axis "
+                         f"(axes: {mesh.axis_names})")
+    fs = stack_sharding(mesh, None, date_axis, asset_axis)
+    ps = panel_sharding(mesh, date_axis, asset_axis)
+    frs = NamedSharding(mesh, PartitionSpec(date_axis, None))
+    return (fs, ps, frs, ps, ps, ps)
+
+
+def _put(a, s):
+    """device_put honoring multi-controller processes (the
+    make_sharded_research_step idiom: each process feeds its addressable
+    shards from its own host copy; plain device_put asserts cross-process
+    VALUE equality with ==, which any NaN panel fails)."""
+    if jax.process_count() > 1:
+        host = np.asarray(a)
+        return jax.make_array_from_callback(host.shape, s,
+                                            lambda idx: host[idx])
+    return jax.device_put(a, s)
+
+
+def make_asset_sharded_research_step(mesh: Mesh, *, names, window: int,
+                                     select_method: str = "icir_top",
+                                     select_kwargs=None,
+                                     blend_method: str = "zscore",
+                                     sim_kwargs=None,
+                                     date_axis: str | None = "auto",
+                                     asset_axis: str = ASSET_AXIS,
+                                     plan: AssetSpecPlan | None = None,
+                                     collect_counters: bool | None = None,
+                                     collect_probes: bool | None = None):
+    """Jit the research step over an asset-carrying mesh.
+
+    Returns ``(jitted_step, shard_inputs)`` exactly like
+    :func:`~factormodeling_tpu.parallel.make_sharded_research_step`, but
+    with the asset axis sharded on every ``[..., N]`` operand and the
+    optional ``plan`` (an :class:`AssetSpecPlan`, typically the
+    :func:`choose_asset_specs` winner) installed while the step TRACES so
+    the sort-site layout constraints are part of the compiled program.
+    ``date_axis="auto"`` uses the mesh's ``"date"`` axis when present
+    (a 2-D ``("date", "assets")`` mesh) and none otherwise (the flat
+    asset mesh).
+    """
+    if date_axis == "auto":
+        date_axis = "date" if "date" in mesh.axis_names else None
+    if plan is not None and plan.mesh is not mesh and (
+            tuple(plan.mesh.axis_names) != tuple(mesh.axis_names)
+            or plan.mesh.devices.shape != mesh.devices.shape
+            or [getattr(d, "id", d) for d in plan.mesh.devices.ravel()]
+            != [getattr(d, "id", d) for d in mesh.devices.ravel()]):
+        # the plan's constraints bind to PLAN.mesh at trace time, so a
+        # plan chosen on a different device grid would silently pin the
+        # stale layout while the spec_choice rows advertise this mesh's
+        raise ValueError(
+            f"plan was chosen on a different mesh "
+            f"(axes {plan.mesh.axis_names}, grid "
+            f"{plan.mesh.devices.shape}) than the step mesh "
+            f"(axes {mesh.axis_names}, grid {mesh.devices.shape}); "
+            f"re-run choose_asset_specs on this mesh")
+    step = build_research_step(names=names, window=window,
+                               select_method=select_method,
+                               select_kwargs=select_kwargs,
+                               blend_method=blend_method,
+                               sim_kwargs=sim_kwargs,
+                               collect_counters=collect_counters,
+                               collect_probes=collect_probes)
+
+    def planned_step(*args):
+        # the plan must be active AT TRACE TIME (ops/_assetspec.py): jit
+        # traces inside this body, so the with-block covers every hint
+        with install_plan(plan):
+            return step(*args)
+
+    in_shardings = asset_in_shardings(mesh, date_axis, asset_axis)
+    spec_table = plan.spec_table() if plan is not None else None
+    record_stage("parallel/asset_shard", kind="stage",
+                 mesh_shape=dict(mesh.shape), factors=len(tuple(names)),
+                 window=window, select_method=select_method,
+                 blend_method=blend_method,
+                 spec_plan=spec_table)
+    jitted = instrument_jit(
+        jax.jit(planned_step, in_shardings=in_shardings),
+        "parallel/asset_research_step/" + entry_point_tag(
+            tuple(names), window, select_method,
+            tuple(sorted((select_kwargs or {}).items())),
+            blend_method, tuple(sorted((sim_kwargs or {}).items())),
+            tuple(mesh.shape.items()), date_axis, asset_axis,
+            tuple(sorted(spec_table.items())) if spec_table else None))
+    jitted.declared_in_shardings = in_shardings
+    jitted.mesh = mesh
+    jitted.plan = plan
+
+    n_size = mesh.shape[asset_axis]
+    d_size = mesh.shape[date_axis] if date_axis is not None else 1
+
+    def shard_inputs(factors, returns, factor_ret, cap_flag, investability,
+                     universe):
+        if returns.shape[-1] % n_size:
+            raise ValueError(
+                f"{returns.shape[-1]} assets are not divisible by the "
+                f"mesh's '{asset_axis}' axis ({n_size}); pad the asset "
+                f"axis (all-NaN columns, universe=False) or pick a mesh "
+                f"whose asset axis divides N")
+        if returns.shape[0] % d_size:
+            raise ValueError(
+                f"{returns.shape[0]} dates are not divisible by the "
+                f"mesh's '{date_axis}' axis ({d_size}); pad the date axis "
+                f"or pick a mesh whose date axis divides D")
+        args = (factors, returns, factor_ret, cap_flag, investability,
+                universe)
+        return tuple(_put(a, s) for a, s in zip(args, in_shardings))
+
+    return jitted, shard_inputs
+
+
+# ---------------------------------------------------------------- chooser
+
+
+def _abstract_inputs(in_shardings, shapes, dtype):
+    """ShapeDtypeStructs carrying the declared shardings — the chooser
+    lowers/compiles candidates WITHOUT materializing (or moving) data."""
+    f, d, n = shapes
+    dims = ((f, d, n), (d, n), (d, f), (d, n), (d, n), (d, n))
+    dtypes = (dtype,) * 5 + (np.bool_,)
+    return tuple(jax.ShapeDtypeStruct(shape, dt, sharding=s)
+                 for shape, dt, s in zip(dims, dtypes, in_shardings))
+
+
+def _stage_bytes(ledger, stage: str) -> float:
+    by_stage = ledger.by_stage()
+    return sum(agg["bytes_moved"] for scope, agg in by_stage.items()
+               if scope in _STAGE_LEDGER_SCOPES.get(stage, ()))
+
+
+def _stage_by_axis(ledger, stage: str) -> dict:
+    """Per-mesh-axis byte split of THIS stage's collectives (summed over
+    its mapped ledger scopes) — the evidence a spec_choice row carries."""
+    out: dict = {}
+    for scope, agg in ledger.by_stage().items():
+        if scope in _STAGE_LEDGER_SCOPES.get(stage, ()):
+            for axis, b in (agg.get("by_axis") or {}).items():
+                out[axis] = out.get(axis, 0.0) + b
+    return out
+
+
+def choose_asset_specs(mesh: Mesh, *, names, window: int, shapes,
+                       select_method: str = "icir_top", select_kwargs=None,
+                       blend_method: str = "zscore", sim_kwargs=None,
+                       date_axis: str | None = "auto",
+                       asset_axis: str = ASSET_AXIS,
+                       stages=ASSET_SORT_STAGES,
+                       modes=_MODES, dtype=np.float64):
+    """Rank every candidate layout mode per sort-site stage by the
+    placement ledger's predicted bytes moved, and return
+    ``(plan, ranking)``:
+
+    - ``plan`` — the winning :class:`AssetSpecPlan` (each stage pinned to
+      its cheapest mode), ready for
+      :func:`make_asset_sharded_research_step`.
+    - ``ranking`` — ``{stage: {"ranked": [[mode, bytes], ...] (ascending),
+      "attribution": "stage" | "total", "by_axis": {axis: bytes}}}`` plus
+      a ``"__total__"`` entry with each candidate's whole-program bytes —
+      the evidence the ``kind="spec_choice"`` rows and the weak-scaling
+      artifact record.
+
+    ``shapes`` is ``(F, D, N)``; candidates compile via ABSTRACT lowering
+    (ShapeDtypeStructs with the declared shardings), so the chooser costs
+    ``len(modes)`` compiles and zero data movement. Ties rank in ``modes``
+    order, so ``"auto"`` (no constraint traced) wins a genuine tie.
+    """
+    if date_axis == "auto":
+        date_axis = "date" if "date" in mesh.axis_names else None
+    in_shardings = asset_in_shardings(mesh, date_axis, asset_axis)
+    abstract = _abstract_inputs(in_shardings, shapes, dtype)
+    step = build_research_step(names=names, window=window,
+                               select_method=select_method,
+                               select_kwargs=select_kwargs,
+                               blend_method=blend_method,
+                               sim_kwargs=sim_kwargs,
+                               collect_counters=False, collect_probes=False)
+
+    ledgers: dict[str, object] = {}
+    for mode in modes:
+        candidate = AssetSpecPlan(mesh, axis=asset_axis, default=mode)
+
+        def mode_step(*args, _p=candidate):
+            with install_plan(_p):
+                return step(*args)
+
+        compiled = jax.jit(mode_step,
+                           in_shardings=in_shardings).lower(
+                               *abstract).compile()
+        ledgers[mode] = obs_comms.comms_ledger(compiled, mesh=mesh)
+
+    totals = {mode: ledgers[mode].totals() for mode in modes}
+    ranking: dict = {"__total__": {
+        "ranked": sorted(([m, totals[m]["bytes_moved"]] for m in modes),
+                         key=lambda mb: (mb[1], modes.index(mb[0]))),
+        "by_axis": {m: totals[m]["by_axis"] for m in modes},
+    }}
+    chosen: dict[str, str] = {}
+    for stage in stages:
+        per_mode = {m: _stage_bytes(ledgers[m], stage) for m in modes}
+        attribution = "stage"
+        if not any(per_mode.values()):
+            # nothing landed under this stage's scopes (hoisted or the
+            # stage never traced): judge by the whole program instead
+            per_mode = {m: totals[m]["bytes_moved"] for m in modes}
+            attribution = "total"
+        ranked = sorted(([m, per_mode[m]] for m in modes),
+                        key=lambda mb: (mb[1], modes.index(mb[0])))
+        chosen[stage] = ranked[0][0]
+        # the winner's per-axis split for THIS stage's scopes; under the
+        # total-attribution fallback the program total is the only
+        # evidence there is, and the row's "attribution" says so
+        by_axis = (_stage_by_axis(ledgers[ranked[0][0]], stage)
+                   if attribution == "stage"
+                   else totals[ranked[0][0]]["by_axis"])
+        ranking[stage] = {"ranked": ranked, "attribution": attribution,
+                          "by_axis": by_axis}
+    return AssetSpecPlan(mesh, axis=asset_axis, modes=chosen), ranking
+
+
+def record_spec_choices(plan: AssetSpecPlan, ranking: dict,
+                        name: str = "asset_spec") -> list[dict]:
+    """Land the chooser's verdicts as ``kind="spec_choice"`` report rows
+    (one per stage) on the active RunReport, and return them. Each row
+    carries the stage, the CHOSEN mode (the plan's — possibly a caller
+    override), the ledger's ranked ``winner``, the full ranking, and the
+    winner's per-axis byte split; ``tools/trace_report.py --strict``
+    fails any row whose chosen disagrees with its winner."""
+    rows = []
+    for stage, entry in ranking.items():
+        if stage == "__total__":
+            continue
+        ranked = entry["ranked"]
+        fields = dict(kind="spec_choice", stage=stage,
+                      chosen=plan.mode_for(stage), winner=ranked[0][0],
+                      ranked=ranked, attribution=entry.get("attribution"),
+                      by_axis=entry.get("by_axis"),
+                      mesh_shape={k: int(v)
+                                  for k, v in plan.mesh.shape.items()})
+        record_stage(f"{name}/{stage}", **fields)
+        rows.append({"name": f"{name}/{stage}", **fields})
+    return rows

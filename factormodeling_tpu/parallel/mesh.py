@@ -6,6 +6,15 @@ unsharded (cross-sectional kernels reduce over it every date) while dates and
 factors spread over the mesh. At BASELINE scale (200 x 5040 x 5000 f32 ~ 20 GB)
 a factor stack exceeds one chip's HBM, so the ``[F, D, N]`` stack shards both
 leading axes across a 2-D ``("factor", "date")`` mesh.
+
+Round 18 makes the asset axis a first-class sharded dimension too: at
+10k+ names the ``[D, N]`` panels and the MVO worksets stop fitting a
+replicated layout, so ``panel_sharding``/``stack_sharding`` optionally
+place a mesh axis on ``N`` and :mod:`factormodeling_tpu.parallel.
+asset_shard` builds the asset-sharded research step (the sort-heavy
+cross-sectional kernels route their layout through the
+``ops/_assetspec`` plan seam there). The canonical asset mesh axis name
+is :data:`ASSET_AXIS`.
 """
 
 from __future__ import annotations
@@ -15,12 +24,16 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
+    "ASSET_AXIS",
     "balanced_mesh_shape",
     "make_mesh",
     "panel_sharding",
     "stack_sharding",
     "replicated",
 ]
+
+#: canonical mesh-axis name for the sharded asset dimension ``N``
+ASSET_AXIS = "assets"
 
 
 def balanced_mesh_shape(n_devices: int, n_axes: int = 2) -> tuple[int, ...]:
@@ -57,15 +70,21 @@ def make_mesh(axis_names: tuple[str, ...] = ("factor", "date"),
     return Mesh(grid, axis_names)
 
 
-def panel_sharding(mesh: Mesh, date_axis: str = "date") -> NamedSharding:
-    """Sharding for a ``[D, N]`` panel: dates sharded, assets local."""
-    return NamedSharding(mesh, PartitionSpec(date_axis, None))
+def panel_sharding(mesh: Mesh, date_axis: str | None = "date",
+                   asset_axis: str | None = None) -> NamedSharding:
+    """Sharding for a ``[D, N]`` panel: dates sharded, assets local by
+    default; pass ``asset_axis`` to shard ``N`` too (either axis may be
+    None for a mesh that lacks it)."""
+    return NamedSharding(mesh, PartitionSpec(date_axis, asset_axis))
 
 
-def stack_sharding(mesh: Mesh, factor_axis: str = "factor",
-                   date_axis: str | None = "date") -> NamedSharding:
-    """Sharding for an ``[F, D, N]`` stack: factors x dates over the mesh."""
-    return NamedSharding(mesh, PartitionSpec(factor_axis, date_axis, None))
+def stack_sharding(mesh: Mesh, factor_axis: str | None = "factor",
+                   date_axis: str | None = "date",
+                   asset_axis: str | None = None) -> NamedSharding:
+    """Sharding for an ``[F, D, N]`` stack: factors x dates over the mesh,
+    plus optionally the asset axis on ``N``."""
+    return NamedSharding(mesh, PartitionSpec(factor_axis, date_axis,
+                                             asset_axis))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

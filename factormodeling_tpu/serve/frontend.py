@@ -65,7 +65,8 @@ from factormodeling_tpu.obs.compile_log import entry_point_tag
 from factormodeling_tpu.parallel import streaming as _streaming
 from factormodeling_tpu.parallel.pipeline import ResearchOutput
 from factormodeling_tpu.serve.batched import make_batched_research_step
-from factormodeling_tpu.serve.tenant import TenantConfig, stack_configs
+from factormodeling_tpu.serve.tenant import (TenantConfig, mesh_key,
+                                             stack_configs)
 
 __all__ = ["DEFAULT_PAD_LADDER", "TenantAdvance", "TenantResult",
            "TenantServer"]
@@ -111,11 +112,26 @@ class TenantServer:
       donate_configs: donate the stacked config buffers to the executable
         (None -> auto: on for non-CPU backends; CPU jaxlib ignores
         donation with a warning, so auto keeps test output clean).
+      mesh: optional ``jax.sharding.Mesh`` carrying a ``(configs x
+        assets)`` layout (round 18, the asset-axis scale-out): the market
+        panels land asset-sharded on their ``N`` dimension, every stacked
+        config batch shards its leading config axis over ``config_axis``
+        (when the rung divides it; smaller rungs replicate), and each
+        bucket's vmapped dispatch partitions over BOTH axes. The mesh
+        placement joins the executable bucket key
+        (:func:`~factormodeling_tpu.serve.tenant.mesh_key`): the same
+        traced config on a different mesh is a DIFFERENT executable, so
+        two meshes never share a bucket (pinned in
+        tests/test_asset_sharding.py). Either axis may be missing
+        (a flat ``("assets",)`` mesh shards panels only).
+      config_axis / asset_axis: the mesh axis names (defaults
+        ``"configs"`` / ``"assets"``).
     """
 
     def __init__(self, *, names, factors, returns, factor_ret, cap_flag,
                  investability, universe=None,
-                 pad_ladder=DEFAULT_PAD_LADDER, donate_configs=None):
+                 pad_ladder=DEFAULT_PAD_LADDER, donate_configs=None,
+                 mesh=None, config_axis="configs", asset_axis="assets"):
         self.names = tuple(names)
         # validated, not normalized: silently sorting/deduping a
         # user-supplied ladder would hide a config error (a descending or
@@ -133,10 +149,15 @@ class TenantServer:
                              f"(no duplicate or out-of-order rungs), "
                              f"got {pad_ladder!r}")
         self.pad_ladder = ladder
+        self.mesh = mesh
+        self._config_axis = config_axis
+        self._asset_axis = asset_axis
         self._panels = tuple(
             None if a is None else jnp.asarray(a)
             for a in (factors, returns, factor_ret, cap_flag, investability,
                       universe))
+        if mesh is not None:
+            self._panels = self._shard_panels(self._panels)
         f, d, n = self._panels[0].shape
         if len(self.names) != f:
             raise ValueError(f"{len(self.names)} names for a factor stack "
@@ -154,12 +175,132 @@ class TenantServer:
         self._stats = {"dispatches": 0, "configs_served": 0,
                        "padded_lanes": 0, "rejected_configs": 0}
 
+    # --------------------------------------------------------- sharding
+
+    def _shard_panels(self, panels):
+        """Asset-shard the market panels onto the server's mesh via the
+        ONE layout definition (``parallel/asset_shard.asset_in_shardings``
+        with no date axis: every ``[..., N]`` panel carries the asset
+        axis on its last dim, ``factor_ret [D, F]`` replicates). A mesh
+        without the asset axis replicates everything."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from factormodeling_tpu.parallel.asset_shard import asset_in_shardings
+
+        if self._asset_axis not in self.mesh.axis_names:
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            shardings = (rep,) * 6
+        else:
+            n = int(panels[1].shape[-1])
+            size = self.mesh.shape[self._asset_axis]
+            if n % size:
+                raise ValueError(
+                    f"{n} assets are not divisible by the mesh's "
+                    f"'{self._asset_axis}' axis ({size}); pad the asset "
+                    f"axis or pick a mesh whose asset axis divides N")
+            shardings = asset_in_shardings(self.mesh, None,
+                                           self._asset_axis)
+        return tuple(
+            None if p is None else jax.device_put(p, s)
+            for p, s in zip(panels, shardings))
+
+    def _shard_stacked(self, stacked, rung: int):
+        """Shard one stacked config pytree's leading config axis over the
+        mesh's config axis; rungs the axis does not divide (the ladder's
+        small rungs) replicate instead — correctness never depends on
+        the split, only the large-rung throughput does."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if self.mesh is None:
+            return stacked
+        c = (self._config_axis
+             if self._config_axis in self.mesh.axis_names else None)
+        if c is not None and rung % self.mesh.shape[c]:
+            c = None
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(
+                leaf, NamedSharding(
+                    self.mesh,
+                    PartitionSpec(c, *([None] * (np.ndim(leaf) - 1))))),
+            stacked)
+
+    def _online_state_specs(self, rung: int, n_assets: int):
+        """(mstate_spec, tstate_spec) leaf->NamedSharding functions for an
+        online session's carried state, or ``(None, None)`` unsharded.
+        Market-state leaves carry the asset axis on any trailing
+        asset-sized dim; tenant-state leaves additionally shard their
+        leading config axis (when the rung divides it). The SAME specs
+        pin the advance's outputs (``batched``'s constraint) so carried
+        state round-trips the AOT executable at a layout fixed point."""
+        if self.mesh is None:
+            return None, None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        a = (self._asset_axis
+             if self._asset_axis in self.mesh.axis_names else None)
+        c = (self._config_axis
+             if self._config_axis in self.mesh.axis_names
+             and rung % self.mesh.shape[self._config_axis] == 0 else None)
+
+        def dims_of(leaf, leading):
+            nd = np.ndim(leaf)
+            dims = [None] * nd
+            if nd and leading:
+                dims[0] = c
+            if nd and np.shape(leaf)[-1] == n_assets and (not leading
+                                                          or nd > 1):
+                dims[-1] = a
+            return dims
+
+        def mspec(leaf):
+            return NamedSharding(self.mesh,
+                                 PartitionSpec(*dims_of(leaf, False)))
+
+        def tspec(leaf):
+            return NamedSharding(self.mesh,
+                                 PartitionSpec(*dims_of(leaf, True)))
+
+        return mspec, tspec
+
+    def _shard_date_slice(self, date_slice):
+        """Asset-shard one arriving date's leaves: anything whose LAST dim
+        is the asset count carries the asset axis there; the ``[F]``
+        factor-return row (and any scalar) replicates."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        a = (self._asset_axis
+             if self._asset_axis in self.mesh.axis_names else None)
+        n = int(self._panels[1].shape[-1])
+
+        def put(leaf):
+            if leaf is None:
+                return None
+            nd = np.ndim(leaf)
+            last = (a if nd and np.shape(leaf)[-1] == n else None)
+            return jax.device_put(leaf, NamedSharding(
+                self.mesh, PartitionSpec(*([None] * (nd - 1) + [last])
+                                         if nd else ())))
+
+        return jax.tree_util.tree_map(put, date_slice)
+
     # ------------------------------------------------------- executables
 
     def _entry_key(self, skey, rung: int) -> tuple:
         shapes = tuple(None if a is None else
                        (tuple(a.shape), str(a.dtype)) for a in self._panels)
-        return ("serve", self.names, skey, rung, shapes)
+        # mesh placement joins the key (serve/tenant.mesh_key docs): the
+        # same bucket on a different mesh compiles different replica
+        # groups, so sharing an executable across meshes would be a
+        # silent miscompile, not a cache hit. An UNSHARDED server keeps
+        # the pre-round-18 key tuple exactly — entry_name() hashes this
+        # tuple, and the queue's latency seeding + report baselines key
+        # on those names
+        key = ("serve", self.names, skey, rung, shapes)
+        if self.mesh is not None:
+            key += (mesh_key(self.mesh),)
+        return key
 
     def entry_name(self, skey, rung: int) -> str:
         """The stable per-(bucket, rung) entry-point name — the key under
@@ -233,7 +374,7 @@ class TenantServer:
         self._buckets_seen.add(skey)
         pad = rung - len(lanes)
         lanes = list(lanes) + [lanes[-1]] * pad  # discarded at demux
-        stacked = stack_configs(lanes)
+        stacked = self._shard_stacked(stack_configs(lanes), rung)
         name, exe = self._executable(skey, rung, template)
         self._executables_seen.add(name)
         out = exe(stacked, *self._panels)
@@ -352,13 +493,6 @@ class TenantServer:
                 dtype=dtype, has_universe=has_universe,
                 stats_tail=stats_tail)
 
-            def batched(tenants, mstate, tstates, date_slice,
-                        _am=am, _at=at):
-                mstate2, octx = _am(mstate, date_slice)
-                tstates2, outs = jax.vmap(
-                    lambda tc, ts: _at(tc, ts, octx))(tenants, tstates)
-                return mstate2, tstates2, outs
-
             one = it()
             # the serve() top-rung split: a bucket wider than the top
             # ladder rung becomes several sessions (chunks of the same
@@ -371,13 +505,45 @@ class TenantServer:
                 lanes = [normalized[i] for i in chunk]
                 pad = rung - len(lanes)
                 lanes = lanes + [lanes[-1]] * pad  # discarded at demux
+                mspec, tspec = self._online_state_specs(rung, n_assets)
+
+                def batched(tenants, mstate, tstates, date_slice,
+                            _am=am, _at=at, _ms=mspec, _ts=tspec):
+                    mstate2, octx = _am(mstate, date_slice)
+                    tstates2, outs = jax.vmap(
+                        lambda tc, ts: _at(tc, ts, octx))(tenants, tstates)
+                    if _ms is not None:
+                        # pin the carried state's layout to the declared
+                        # specs: the AOT artifact's next dispatch feeds
+                        # these outputs back as inputs, so input and
+                        # output shardings must be a FIXED POINT — without
+                        # the constraint XLA may prefer a different
+                        # output layout and the second advance rejects it
+                        from jax.lax import with_sharding_constraint
+
+                        mstate2 = jax.tree_util.tree_map(
+                            lambda a: with_sharding_constraint(a, _ms(a)),
+                            mstate2)
+                        tstates2 = jax.tree_util.tree_map(
+                            lambda a: with_sharding_constraint(a, _ts(a)),
+                            tstates2)
+                    return mstate2, tstates2, outs
+
+                mstate0 = im()
+                tstates0 = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *([one] * rung))
+                if mspec is not None:
+                    mstate0 = jax.tree_util.tree_map(
+                        lambda a: jax.device_put(a, mspec(a)), mstate0)
+                    tstates0 = jax.tree_util.tree_map(
+                        lambda a: jax.device_put(a, tspec(a)), tstates0)
                 self._online[(skey, lo)] = {
                     "members": chunk, "rung": rung, "pad": pad,
                     "template": template,
-                    "stacked": stack_configs(lanes),
-                    "mstate": im(),
-                    "tstates": jax.tree_util.tree_map(
-                        lambda *xs: jnp.stack(xs), *([one] * rung)),
+                    "stacked": self._shard_stacked(stack_configs(lanes),
+                                                   rung),
+                    "mstate": mstate0,
+                    "tstates": tstates0,
                     "batched": batched,
                     "key": ("online", self.names, skey, rung, stats_tail,
                             self._entry_key(skey, rung)),
@@ -417,6 +583,8 @@ class TenantServer:
         if not getattr(self, "_online", None):
             raise RuntimeError("advance_all before online_begin — open an "
                                "online session first")
+        if self.mesh is not None:
+            date_slice = self._shard_date_slice(date_slice)
         results: list = [None] * len(self._online_configs)
         for skey, session in self._online.items():
             name, exe = self._online_executable(session)
@@ -450,4 +618,6 @@ class TenantServer:
                 "executables": len(self._executables_seen),
                 **self._stats,
                 "pad_ladder": self.pad_ladder,
+                "mesh_shape": (dict(self.mesh.shape)
+                               if self.mesh is not None else None),
                 "kernel_cache": _streaming.streaming_cache_stats()}

@@ -38,7 +38,7 @@ from typing import Any
 import jax
 import numpy as np
 
-__all__ = ["TenantConfig", "stack_configs"]
+__all__ = ["TenantConfig", "mesh_key", "stack_configs"]
 
 #: weight schemes a tenant may request (SimulationSettings.method)
 _METHODS = ("equal", "linear", "mvo", "mvo_turnover")
@@ -287,6 +287,25 @@ class TenantConfig:
             turnover_penalty=f(self.turnover_penalty),
             return_weight=f(self.return_weight),
             tcost_scale=f(self.tcost_scale))
+
+
+def mesh_key(mesh) -> tuple:
+    """Hashable placement descriptor of a device mesh, for executable
+    bucket keys: axis names, per-axis sizes, and the device-id grid
+    (flattened, with platform). The SAME traced config on a DIFFERENT
+    mesh is a different compiled program — the partitioner bakes the
+    replica groups into the executable — so mesh placement must join
+    :meth:`TenantConfig.static_key` wherever executables are cached
+    (``TenantServer._entry_key`` threads this; pinned by the
+    two-meshes-don't-share-a-bucket regression in
+    tests/test_asset_sharding.py). ``None`` (the unsharded server) keys
+    as ``()`` so pre-round-18 cache keys are unchanged."""
+    if mesh is None:
+        return ()
+    ids = tuple(int(getattr(d, "id", d)) for d in mesh.devices.ravel())
+    platform = getattr(mesh.devices.ravel()[0], "platform", "?")
+    return (tuple(mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape), ids, platform)
 
 
 def stack_configs(configs) -> TenantConfig:

@@ -1,0 +1,512 @@
+"""Asset-axis scale-out (round 18, docs/architecture.md §24).
+
+Load-bearing guarantees:
+
+- the ``ops/_assetspec`` seam is structurally elided: with no active
+  plan, ``hint`` returns its operand BY IDENTITY — the pre-round-18
+  callers trace byte-identical HLO;
+- the asset-sharded research step equals the unsharded step on the same
+  inputs under EVERY layout mode (auto / reshard / gather / the
+  chooser's mixed plan) and on both the flat ``("assets",)`` and the 2-D
+  ``("date", "assets")`` mesh — 1e-10 in f64, the documented tolerance
+  for reordered partial reductions;
+- the ledger-driven chooser ranks candidate modes by predicted bytes,
+  its plan pins each stage's ranked winner, and the ``kind="spec_choice"``
+  rows it records gate through ``tools/trace_report.py --strict`` (a
+  chosen-vs-winner disagreement exits 1 from the artifact alone);
+- ``report_diff`` gates per-axis comms bytes: an asset-axis blowup
+  inside one stage is a regression even when the stage TOTAL stays
+  inside the ratio;
+- a ``TenantServer`` on a ``(configs x assets)`` mesh serves and
+  advances bit-compatibly with the unsharded server, and two meshes
+  NEVER share an executable bucket (mesh placement joins the bucket
+  key — the satellite regression);
+- the PR 13 online state machine does not fork under asset sharding:
+  the sharded-vs-unsharded per-date differential holds across the
+  equal/linear/mvo/mvo_turnover x NaN/ragged ladder.
+
+Tier-1 budget note: the container's 870 s tier-1 window is
+oversubscribed, so the redundant rungs of the compile-heavy
+differentials (the extra uniform modes, the ladder cells beyond the
+two most fork-prone) carry ``@pytest.mark.slow`` — representative
+coverage stays in tier-1, the full matrix runs with ``-m slow``.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factormodeling_tpu import obs
+from factormodeling_tpu.obs.regression import diff_reports
+from factormodeling_tpu.ops import _assetspec
+from factormodeling_tpu.parallel import (
+    build_research_step,
+    choose_asset_specs,
+    make_asset_mesh,
+    make_asset_sharded_research_step,
+    make_mesh,
+    record_spec_choices,
+)
+from factormodeling_tpu.parallel.asset_shard import AssetSpecPlan
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO / "tools") not in sys.path:  # for `import trace_report`
+    sys.path.insert(0, str(REPO / "tools"))
+
+NAMES = ("mom_eq", "mom_flx", "val_long", "val_short",
+         "qual_eq", "qual_flx", "size_long", "size_short")
+F, D, N, WINDOW = len(NAMES), 24, 16, 6
+CFG = dict(names=NAMES, window=WINDOW,
+           sim_kwargs=dict(method="equal", pct=0.3))
+
+
+def make_inputs(rng, nan_frac=0.05):
+    factors = rng.normal(size=(F, D, N))
+    factors[rng.uniform(size=factors.shape) < nan_frac] = np.nan
+    returns = rng.normal(scale=0.02, size=(D, N))
+    factor_ret = rng.normal(scale=0.01, size=(D, F))
+    cap = rng.integers(1, 4, size=(D, N)).astype(float)
+    invest = np.ones((D, N))
+    universe = np.ones((D, N), dtype=bool)
+    return (factors, returns, factor_ret, cap, invest, universe)
+
+
+# ------------------------------------------------------------ the seam
+
+
+def test_hint_without_plan_is_identity():
+    """Structural elision: no active plan means hint IS the identity —
+    same object, nothing traced — so every pre-round-18 caller's HLO is
+    untouched by the seam's existence."""
+    x = jnp.ones((3, 5))
+    assert _assetspec.active_plan() is None
+    assert _assetspec.hint(x, "ops/rank") is x
+    assert _assetspec.hint(x, "metrics/rank_ic", sort_dim=0) is x
+
+
+def test_plan_validates_modes_and_mesh_axis():
+    mesh = make_asset_mesh(n_devices=2)
+    with pytest.raises(ValueError, match="unknown asset-spec mode"):
+        AssetSpecPlan(mesh, modes={"ops/rank": "teleport"})
+    with pytest.raises(ValueError, match="unknown default mode"):
+        AssetSpecPlan(mesh, default="teleport")
+    no_assets = make_mesh(("factor", "date"))
+    with pytest.raises(ValueError, match="no 'assets' axis"):
+        AssetSpecPlan(no_assets)
+
+
+def test_plan_restores_on_exit():
+    mesh = make_asset_mesh(n_devices=2)
+    p = AssetSpecPlan(mesh)
+    with _assetspec.plan(p) as active:
+        assert active is p
+        assert _assetspec.active_plan() is p
+    assert _assetspec.active_plan() is None
+
+
+# ------------------------------------- sharded == unsharded, all modes
+
+
+def _single(inputs):
+    return jax.jit(build_research_step(**CFG))(
+        *[jnp.asarray(a) for a in inputs])
+
+
+def _assert_step_equal(single, sharded):
+    np.testing.assert_allclose(np.asarray(single.selection),
+                               np.asarray(sharded.selection), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(single.signal),
+                               np.asarray(sharded.signal), atol=1e-10,
+                               equal_nan=True)
+    np.testing.assert_allclose(
+        np.asarray(single.sim.result.log_return),
+        np.asarray(sharded.sim.result.log_return), atol=1e-10,
+        equal_nan=True)
+
+
+@pytest.mark.parametrize("mode", [
+    "auto",
+    pytest.param("reshard", marks=pytest.mark.slow),
+    pytest.param("gather", marks=pytest.mark.slow),
+])
+def test_asset_sharded_step_matches_unsharded(rng, mode):
+    """Flat 8-way asset mesh, every uniform layout mode: the sharded
+    step reproduces the unsharded one on identical inputs. (The
+    explicit-constraint modes also run per-stage through the 2-D-mesh
+    mixed plan below, so tier-1 keeps "auto" and the mixed plan; the
+    uniform reshard/gather rungs ride -m slow.)"""
+    inputs = make_inputs(rng)
+    mesh = make_asset_mesh()
+    plan = AssetSpecPlan(mesh, default=mode)
+    step, shard_inputs = make_asset_sharded_research_step(mesh, plan=plan,
+                                                          **CFG)
+    _assert_step_equal(_single(inputs), step(*shard_inputs(*inputs)))
+
+
+def test_asset_sharded_step_on_2d_date_asset_mesh(rng):
+    """The 2-D ("date", "assets") mesh — dates AND assets sharded at
+    once, the multi-host layout of parallel/_dist_check.py's asset leg —
+    through a MIXED plan (both constraint modes traced in one program)."""
+    inputs = make_inputs(rng)
+    mesh = make_mesh(("date", "assets"))
+    assert set(dict(mesh.shape)) == {"date", "assets"}
+    plan = AssetSpecPlan(mesh, modes={"metrics/rank_ic": "gather",
+                                      "ops/rank": "gather",
+                                      "backtest/weights": "reshard"})
+    step, shard_inputs = make_asset_sharded_research_step(mesh, plan=plan,
+                                                          **CFG)
+    _assert_step_equal(_single(inputs), step(*shard_inputs(*inputs)))
+
+
+def test_shard_inputs_rejects_indivisible_asset_axis(rng):
+    inputs = make_inputs(rng)
+    bad = tuple(np.asarray(a)[..., :-1] if a.shape[-1] == N else a
+                for a in inputs)
+    mesh = make_asset_mesh()
+    _, shard_inputs = make_asset_sharded_research_step(mesh, **CFG)
+    with pytest.raises(ValueError, match="not divisible by the mesh's "
+                                         "'assets'"):
+        shard_inputs(*bad)
+
+
+# ------------------------------------------------- the ledger chooser
+
+
+@pytest.fixture(scope="module")
+def chooser():
+    """One chooser run (3 abstract compiles, at half the differential's
+    date count — the ranking logic is shape-driven, not data-driven)
+    shared by every chooser assertion in the module."""
+    mesh = make_asset_mesh()
+    plan, ranking = choose_asset_specs(mesh, shapes=(F, 12, N), **CFG)
+    return mesh, plan, ranking
+
+
+def test_choose_asset_specs_ranks_by_ledger_bytes(chooser):
+    _, plan, ranking = chooser
+    assert set(plan.spec_table()) == set(_assetspec.ASSET_SORT_STAGES)
+    for stage in _assetspec.ASSET_SORT_STAGES:
+        entry = ranking[stage]
+        ranked = entry["ranked"]
+        assert [m for m, _ in ranked] != []
+        assert sorted(b for _, b in ranked) == [b for _, b in ranked]
+        # the plan pins each stage's ranked winner
+        assert plan.mode_for(stage) == ranked[0][0]
+        assert entry["attribution"] in ("stage", "total")
+    total = ranking["__total__"]["ranked"]
+    assert {m for m, _ in total} == {"auto", "reshard", "gather"}
+    # the per-axis split justifies the choice (ISSUE: "per-axis byte
+    # totals"): on a flat assets mesh every byte crosses the assets axis
+    for _, by_axis in ranking["__total__"]["by_axis"].items():
+        assert set(by_axis) <= {"assets", "unknown"}
+
+
+def test_spec_choice_rows_record_and_pass_strict(chooser):
+    import trace_report
+
+    mesh, plan, ranking = chooser
+    rep = obs.RunReport("asset-spec")
+    with rep.activate():
+        rows = record_spec_choices(plan, ranking)
+    assert len(rows) == len(_assetspec.ASSET_SORT_STAGES)
+    recorded = [r for r in rep.rows if r.get("kind") == "spec_choice"]
+    assert len(recorded) == len(rows)
+    for r in recorded:
+        assert r["chosen"] == r["winner"]
+        assert r["mesh_shape"] == dict(mesh.shape)
+    assert trace_report.spec_mismatches(rep.rows) == []
+    # the rendered report carries the spec table
+    assert "asset-spec choices" in trace_report.render(rep.rows)
+
+
+def test_spec_mismatch_fails_strict_from_artifact(tmp_path):
+    """A chosen spec that disagrees with the ledger's ranked winner —
+    a hand-pinned PartitionSpec the ledger prices as more bytes — fails
+    ``trace_report --strict`` from the JSONL alone."""
+    import trace_report
+
+    good = {"kind": "spec_choice", "name": "asset_spec/ops/rank",
+            "stage": "ops/rank", "chosen": "gather", "winner": "gather",
+            "ranked": [["gather", 100.0], ["reshard", 200.0]]}
+    bad = dict(good, name="asset_spec/ops/quantile",
+               stage="ops/quantile", chosen="reshard")
+    ok_path = tmp_path / "ok.jsonl"
+    ok_path.write_text(json.dumps(good) + "\n")
+    bad_path = tmp_path / "bad.jsonl"
+    bad_path.write_text(json.dumps(good) + "\n" + json.dumps(bad) + "\n")
+    assert trace_report.main([str(ok_path), "--strict"]) == 0
+    assert trace_report.main([str(bad_path), "--strict"]) == 1
+    assert trace_report.spec_mismatches([bad])[0].startswith(
+        "spec_choice row 'asset_spec/ops/quantile'")
+    # a malformed row (missing winner) fails too
+    assert trace_report.spec_mismatches(
+        [{"kind": "spec_choice", "name": "x", "chosen": "auto"}])
+
+
+# ------------------------------------------- per-axis comms gating
+
+
+def _comms_row(stage, total, by_axis):
+    return {"kind": "comms", "name": "step", "stage": stage,
+            "collectives": {"all-reduce": {"count": 1,
+                                           "bytes_moved": total}},
+            "bytes_moved": total, "by_axis": by_axis}
+
+
+def test_report_diff_gates_per_axis_byte_growth():
+    """An asset-axis blowup hidden inside a flat stage total: the total
+    gate passes (ratio 1.1), the per-axis gate catches it."""
+    base = [_comms_row("selection/rolling", 100e3,
+                       {"date": 90e3, "assets": 10e3})]
+    new = [_comms_row("selection/rolling", 110e3,
+                      {"date": 20e3, "assets": 90e3})]
+    result = diff_reports(base, new)
+    assert not result.ok
+    labels = [f.name for f in result.regressions]
+    assert any("axis:assets" in l for l in labels), labels
+    # and the reverse direction (shrink) never gates
+    assert diff_reports(new, new).ok
+
+
+def test_plan_from_another_mesh_is_rejected():
+    """A plan chosen on a different device grid must not silently bind
+    its constraints to the stale mesh while the spec rows advertise the
+    step's (the review-repro regression)."""
+    plan = AssetSpecPlan(make_asset_mesh(n_devices=2))
+    with pytest.raises(ValueError, match="different mesh"):
+        make_asset_sharded_research_step(make_asset_mesh(), plan=plan,
+                                         **CFG)
+
+
+def test_per_axis_gate_notes_but_never_flags_pre_round18_baselines():
+    """A baseline whose per-stage rows predate the by_axis split would
+    read every axis as 0 -> N growth on a byte-identical program; that
+    case must be a re-baseline note, not a regression."""
+    base = [{k: v for k, v in
+             _comms_row("selection/rolling", 50e3, {}).items()
+             if k != "by_axis"}]
+    new = [_comms_row("selection/rolling", 50e3, {"date": 50e3})]
+    result = diff_reports(base, new)
+    assert result.ok, [f.render() for f in result.regressions]
+    assert any("re-baseline" in f.render() for f in result.findings)
+
+
+def test_report_diff_per_axis_respects_floor_and_ratio():
+    base = [_comms_row("selection/rolling", 100e3,
+                       {"date": 90e3, "assets": 10e3})]
+    ok_new = [_comms_row("selection/rolling", 101e3,
+                         {"date": 90e3, "assets": 11e3})]
+    assert diff_reports(base, ok_new).ok  # 1.1x, within ratio
+    tiny = [_comms_row("selection/rolling", 100.0, {"assets": 100.0})]
+    tiny_new = [_comms_row("selection/rolling", 900.0, {"assets": 900.0})]
+    assert diff_reports(tiny, tiny_new).ok  # below the 1 KiB floor
+
+
+# --------------------------------------- sharded TenantServer bucket
+
+
+def _market(rng, f=4, d=24, n=16):
+    names = ("a_eq", "a_flx", "b_long", "b_short")[:f]
+    factors = rng.normal(size=(f, d, n))
+    returns = rng.normal(scale=0.02, size=(d, n))
+    fr = rng.normal(scale=0.01, size=(d, f))
+    cap = rng.integers(1, 4, size=(d, n)).astype(float)
+    return dict(names=names, factors=factors, returns=returns,
+                factor_ret=fr, cap_flag=cap,
+                investability=np.ones((d, n)),
+                universe=np.ones((d, n), dtype=bool))
+
+
+def test_tenant_server_sharded_bucket_matches_unsharded(rng):
+    from factormodeling_tpu.serve.frontend import TenantServer
+    from factormodeling_tpu.serve.tenant import TenantConfig
+
+    kw = _market(rng)
+    mesh = make_asset_mesh(("configs", "assets"))
+    cfgs = [TenantConfig(window=WINDOW, top_k=k, method="equal")
+            for k in (1, 2, 3, 4)]
+    s0 = TenantServer(pad_ladder=(1, 4), **kw)
+    s1 = TenantServer(mesh=mesh, pad_ladder=(1, 4), **kw)
+    r0, r1 = s0.serve(cfgs), s1.serve(cfgs)
+    for a, b in zip(r0, r1):
+        np.testing.assert_allclose(np.asarray(a.output.signal),
+                                   np.asarray(b.output.signal),
+                                   atol=1e-10, equal_nan=True)
+        np.testing.assert_allclose(
+            np.asarray(a.output.sim.result.log_return),
+            np.asarray(b.output.sim.result.log_return),
+            atol=1e-10, equal_nan=True)
+    assert s1.serving_stats()["mesh_shape"] == dict(mesh.shape)
+
+
+def test_two_meshes_never_share_an_executable_bucket(rng):
+    """The satellite regression: the SAME traced config must compile
+    per-mesh — mesh placement joins the bucket key, so two meshes (and
+    mesh-vs-unsharded) produce distinct entry points instead of silently
+    reusing an executable whose replica groups assume the other mesh."""
+    from factormodeling_tpu.serve.frontend import TenantServer
+    from factormodeling_tpu.serve.tenant import TenantConfig, mesh_key
+
+    kw = _market(rng)
+    devices = jax.devices()
+    mesh_a = make_asset_mesh(("configs", "assets"))
+    mesh_b = make_asset_mesh(n_devices=4)  # flat 4-way assets
+    cfg = TenantConfig(window=WINDOW, method="equal")
+    servers = [TenantServer(pad_ladder=(1, 4), **kw),
+               TenantServer(mesh=mesh_a, pad_ladder=(1, 4), **kw),
+               TenantServer(mesh=mesh_b, pad_ladder=(1, 4), **kw)]
+    skey = cfg.static_key()
+    keys = {s._entry_key(skey, 1) for s in servers}
+    names = {s.entry_name(skey, 1) for s in servers}
+    assert len(keys) == 3 and len(names) == 3
+    # mesh_key itself distinguishes placement but not equality-identical
+    # meshes (same axes, same devices = the same program)
+    assert mesh_key(None) == ()
+    assert mesh_key(mesh_a) != mesh_key(mesh_b)
+    assert mesh_key(mesh_a) == mesh_key(
+        make_asset_mesh(("configs", "assets"), devices=devices))
+
+
+# ------------------------- online advance: the sharding differential
+
+
+LADDER = {
+    "equal": dict(),
+    "linear": dict(),
+    "mvo": dict(sim_static=(("mvo_batch", 4), ("qp_iters", 40))),
+    "mvo_turnover": dict(sim_static=(("qp_iters", 40),)),
+}
+
+
+def _online_market(rng, d, n, ragged):
+    f = 4
+    names = ("a_eq", "a_flx", "b_long", "b_short")
+    factors = rng.normal(size=(f, d, n))
+    factors[rng.uniform(size=factors.shape) < 0.1] = np.nan
+    returns = rng.normal(scale=0.02, size=(d, n))
+    fr = rng.normal(scale=0.01, size=(d, f))
+    cap = rng.integers(1, 4, size=(d, n)).astype(float)
+    invest = np.ones((d, n))
+    universe = np.ones((d, n), dtype=bool)
+    if ragged:
+        for j in range(0, n, 3):
+            a = int(rng.integers(2, d - 4))
+            universe[a:a + 2, j] = False
+        returns = np.where(universe, returns, np.nan)
+    return names, factors, returns, fr, cap, invest, universe
+
+
+#: tier-1 keeps the cheapest cell and the hardest (the turnover scan's
+#: carried state over a ragged universe — the cell a sharding fork would
+#: hit first); the remaining six ride -m slow (module docstring)
+_TIER1_CELLS = {("equal", "nan"), ("mvo_turnover", "ragged")}
+
+
+@pytest.mark.parametrize(
+    "method,market",
+    [pytest.param(m, mk,
+                  marks=() if (m, mk) in _TIER1_CELLS
+                  else pytest.mark.slow)
+     for m in sorted(LADDER) for mk in ("nan", "ragged")])
+def test_online_advance_does_not_fork_under_asset_sharding(
+        rng, method, market):
+    """The PR 13 state machine, date by date, sharded vs unsharded: the
+    panel rows (selection / signal / traded weights / leg counts /
+    solver verdicts) agree to 1e-12 and the P&L scalars to 1e-12 —
+    reordered partial reductions are the ONLY permitted difference, so
+    the state evolution itself cannot fork."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from factormodeling_tpu.online.advance import make_online_step
+    from factormodeling_tpu.online.state import DateSlice
+    from factormodeling_tpu.serve.tenant import TenantConfig
+
+    d, n = 12, 16
+    names, factors, returns, fr, cap, invest, universe = _online_market(
+        rng, d, n, market == "ragged")
+    template = TenantConfig(window=4, method=method, lookback_period=6,
+                            **LADDER[method])
+    template = template.normalized(len(names), 2)
+
+    def run(mesh):
+        init_fn, advance_fn = make_online_step(
+            names=names, template=template, n_assets=n,
+            has_universe=True, stats_tail=8)
+        step = jax.jit(advance_fn)
+        mstate, tstate = init_fn()
+        outs = []
+        for t in range(d):
+            ds = DateSlice(factors=jnp.asarray(factors[:, t, :]),
+                           returns=jnp.asarray(returns[t]),
+                           factor_ret=jnp.asarray(fr[t]),
+                           cap_flag=jnp.asarray(cap[t]),
+                           investability=jnp.asarray(invest[t]),
+                           universe=jnp.asarray(universe[t]))
+            if mesh is not None:
+                def put(a):
+                    nd = np.ndim(a)
+                    dims = [None] * nd
+                    if nd and np.shape(a)[-1] == n:
+                        dims[-1] = "assets"
+                    return jax.device_put(a, NamedSharding(
+                        mesh, PartitionSpec(*dims)))
+
+                ds = jax.tree_util.tree_map(put, ds)
+            (mstate, tstate), out = step(template, mstate, tstate, ds)
+            outs.append(out)
+        return outs
+
+    base = run(None)
+    sharded = run(make_asset_mesh())
+    for t, (a, b) in enumerate(zip(base, sharded)):
+        for field in ("selection", "signal", "weights"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(a, field)),
+                np.asarray(getattr(b, field)), atol=1e-12,
+                equal_nan=True, err_msg=f"{field} day {t}")
+        for field in ("long_count", "short_count", "solver_ok", "ready"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, field)),
+                np.asarray(getattr(b, field)), err_msg=f"{field} day {t}")
+        for field in ("log_return", "turnover"):
+            np.testing.assert_allclose(
+                float(getattr(a, field)), float(getattr(b, field)),
+                atol=1e-12, err_msg=f"{field} day {t}")
+
+
+def test_tenant_server_online_sharded_matches_unsharded(rng):
+    """advance_all on the (configs x assets) mesh: the carried state
+    round-trips the AOT executable at a layout fixed point and every
+    lane reproduces the unsharded server's stream."""
+    from factormodeling_tpu.online.state import DateSlice
+    from factormodeling_tpu.serve.frontend import TenantServer
+    from factormodeling_tpu.serve.tenant import TenantConfig
+
+    kw = _market(rng)
+    d = kw["returns"].shape[0]
+    mesh = make_asset_mesh(("configs", "assets"))
+    cfgs = [TenantConfig(window=WINDOW, top_k=k, method="equal")
+            for k in (1, 2)]
+    s0 = TenantServer(pad_ladder=(2,), **kw)
+    s1 = TenantServer(mesh=mesh, pad_ladder=(2,), **kw)
+    s0.online_begin(cfgs)
+    s1.online_begin(cfgs)
+    for t in range(min(d, 6)):
+        ds = DateSlice(factors=jnp.asarray(kw["factors"][:, t, :]),
+                       returns=jnp.asarray(kw["returns"][t]),
+                       factor_ret=jnp.asarray(kw["factor_ret"][t]),
+                       cap_flag=jnp.asarray(kw["cap_flag"][t]),
+                       investability=jnp.asarray(kw["investability"][t]),
+                       universe=jnp.asarray(kw["universe"][t]))
+        for a, b in zip(s0.advance_all(ds), s1.advance_all(ds)):
+            np.testing.assert_allclose(np.asarray(a.output.weights),
+                                       np.asarray(b.output.weights),
+                                       atol=1e-12, equal_nan=True)
+            np.testing.assert_allclose(float(a.output.log_return),
+                                       float(b.output.log_return),
+                                       atol=1e-12)

@@ -230,3 +230,17 @@ def test_cli_reports_findings(tmp_path, capsys):
     assert rc == 1 and "1 finding(s)" in out
     rc_clean = lint_timing.main([str(REPO / "tools" / "trace_report.py")])
     assert rc_clean == 0
+
+
+def test_default_targets_cover_the_parallel_and_sharding_seam_modules():
+    """Round 18 extends the surface over factormodeling_tpu/parallel/
+    (the sharded-step factories and the weak-scaling/spec-chooser
+    machinery make timing and byte claims) and the ops sharding seam
+    the asset plan threads through. Pinned by name so a future move
+    can't silently drop them from the linted surface."""
+    targets = lint_timing.default_targets(REPO)
+    parallel = {p.name for p in targets if p.parent.name == "parallel"}
+    assert {"asset_shard.py", "mesh.py", "pipeline.py",
+            "streaming.py"} <= parallel
+    names = {p.name for p in targets}
+    assert {"_assetspec.py", "_rank.py", "weak_scaling.py"} <= names

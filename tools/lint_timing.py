@@ -251,7 +251,13 @@ def default_targets(repo_root=None) -> list[Path]:
     product's SLO surface, published only through the bench's fenced
     sketches), exactly where an unfenced "time one ingest" window would
     be tempting and would time async dispatch — pinned by name in
-    tests/test_lint_timing.py."""
+    tests/test_lint_timing.py. The parallel package and the ops sharding
+    seam (round 18) join with the asset-axis scale-out: the weak-scaling
+    harness and spec chooser make byte/efficiency CLAIMS from compiled
+    artifacts, and the sharded-step factories are where a quick
+    "time the mesh speedup" window would land unfenced — the whole
+    parallel/ glob plus the non-Pallas ops modules the asset plan
+    threads through, pinned by name in tests/test_lint_timing.py."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))
@@ -260,6 +266,8 @@ def default_targets(repo_root=None) -> list[Path]:
             + sorted((pkg / "obs").glob("*.py"))
             + sorted((pkg / "online").glob("*.py"))
             + sorted((pkg / "ops").glob("_pallas_*.py"))
+            + [pkg / "ops" / "_assetspec.py", pkg / "ops" / "_rank.py"]
+            + sorted((pkg / "parallel").glob("*.py"))
             + sorted((pkg / "resil").glob("*.py"))
             + sorted((pkg / "scenarios").glob("*.py"))
             + sorted((pkg / "serve").glob("*.py"))

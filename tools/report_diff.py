@@ -13,7 +13,10 @@ baseline (``tests/goldens/obs_report_clean.jsonl`` is the committed
 example), and gate merges on this diff — a span that got 1.5x slower, a
 solver-fallback counter that ticked up, a probe stage whose finite
 fraction dropped (the watchdog names the first bad stage), a silent jit
-retrace, a new collective / comms-byte blowup in the placement ledger, a
+retrace, a new collective / comms-byte blowup in the placement ledger
+(gated per stage AND — round 18 — per mesh axis, so an asset-axis byte
+blowup in one stage cannot hide behind another axis's shrinkage; the
+asset-sharded step's rows arm through the same ``--comms-ratio``), a
 peak-device-memory jump, a sharding-lint flag (replicated/resharded
 operand), a latency-sketch p50/p99 beyond the wall ratio, a violated
 ``SLOSpec`` budget (gated even under ``--no-wall`` — the budget is the

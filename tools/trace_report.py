@@ -18,10 +18,13 @@ records print in their own sections. Pure stdlib — usable on any box that has 
 required.
 
 Exit codes: 0 = rendered (``--strict`` turns unsound spans, sharding-lint
-flags, SLO violations, and malformed latency/devtime/serving/scenario/
+flags, SLO violations, malformed latency/devtime/serving/scenario/
 online rows (a scenario risk row with non-finite VaR/ES fails strict) — a
 serving row whose verdict counts do not sum to its submissions, an
-online row whose verdicts do not sum to its ingestions — into 1);
+online row whose verdicts do not sum to its ingestions — and asset-spec
+disagreements (a ``kind="spec_choice"`` row whose ``chosen`` layout mode
+is not the placement ledger's ranked ``winner`` — a hand-pinned
+PartitionSpec the ledger prices as moving more bytes) into 1);
 2 = unusable input (missing/unreadable file, or no parseable rows at all
 — empty or fully corrupt). A truncated tail — a run killed mid-write — is
 skipped with a file:line warning and the surviving rows still render:
@@ -485,13 +488,36 @@ def _scenario_table(rows) -> str | None:
                           "ES@level", "p50/p99", "nonfinite"), body))
 
 
+def _spec_table(rows) -> str | None:
+    sp = [r for r in rows if r.get("kind") == "spec_choice"]
+    if not sp:
+        return None
+    last: dict[str, dict] = {}
+    for r in sp:
+        last[r.get("name", r.get("stage", "?"))] = r
+    body = []
+    for name, r in sorted(last.items()):
+        ranked = r.get("ranked") or []
+        ranked_s = " ".join(f"{m}:{_num(b)}" for m, b in ranked
+                            if isinstance(b, (int, float))) or "-"
+        agree = "OK" if r.get("chosen") == r.get("winner") else "MISMATCH"
+        body.append((name, r.get("stage", "?"), r.get("chosen", "?"),
+                     r.get("winner", "?"), agree, ranked_s,
+                     r.get("attribution", "-")))
+    return ("== asset-spec choices (ledger-ranked layout mode per sort "
+            "stage; chosen must equal winner under --strict) ==\n"
+            + _fmt_table(("row", "stage", "chosen", "winner", "verdict",
+                          "ranked (mode:bytes)", "attribution"), body))
+
+
 def _stage_table(rows) -> str | None:
     stages = [r for r in rows
               if r.get("kind") not in ("span", "counters", "cost", "bench",
                                        "numerics", "watchdog", "compile",
                                        "comms", "memory", "sharding",
                                        "latency", "devtime", "serving",
-                                       "scenario", "online", "meta")]
+                                       "scenario", "online", "meta",
+                                       "spec_choice")]
     if not stages:
         return None
     body = []
@@ -538,7 +564,7 @@ def render(rows) -> str:
     for maker in (_span_table, _latency_table, _serving_table,
                   _online_table, _scenario_table, _counter_table, _solver_table,
                   _numerics_table, _watchdog_table, _compile_table,
-                  _comms_table, _memory_table, _sharding_table,
+                  _comms_table, _spec_table, _memory_table, _sharding_table,
                   _devtime_table, _cost_table, _bench_table, _stage_table):
         section = maker(rows)
         if section:
@@ -575,6 +601,30 @@ def slo_violations(rows) -> list[str]:
     return sorted({r.get("name", "?") for r in rows
                    if r.get("kind") == "latency"
                    and r.get("slo_violated")})
+
+
+def spec_mismatches(rows) -> list[str]:
+    """Descriptions of ``kind="spec_choice"`` rows whose CHOSEN layout
+    mode disagrees with the placement ledger's ranked ``winner`` — the
+    asset-axis half of the ``--strict`` gate (round 18): a pinned
+    PartitionSpec the ledger prices as moving more bytes than its
+    cheapest candidate should fail CI from the artifact alone. A row
+    missing either field is malformed and fails too."""
+    bad = []
+    for r in rows:
+        if r.get("kind") != "spec_choice":
+            continue
+        name = r.get("name", r.get("stage", "?"))
+        chosen, winner = r.get("chosen"), r.get("winner")
+        if not isinstance(chosen, str) or not isinstance(winner, str):
+            bad.append(f"spec_choice row {name!r}: missing chosen/winner "
+                       f"({chosen!r}/{winner!r})")
+        elif chosen != winner:
+            ranked = r.get("ranked") or []
+            bad.append(f"spec_choice row {name!r}: chosen {chosen!r} but "
+                       f"the ledger ranks {winner!r} cheapest "
+                       f"(ranked: {ranked})")
+    return bad
 
 
 def malformed_rows(rows) -> list[str]:
@@ -679,9 +729,11 @@ def main(argv=None) -> int:
                              "(fenced NO: neither a device fence nor a "
                              "declared host-synchronous window), any "
                              "sharding-lint row is flagged, any latency "
-                             "SLO is violated, or any latency/devtime/"
+                             "SLO is violated, any latency/devtime/"
                              "serving/scenario row is malformed (incl. "
-                             "non-finite VaR/ES) — makes the "
+                             "non-finite VaR/ES), or any spec_choice "
+                             "row's chosen layout disagrees with the "
+                             "ledger's ranked winner — makes the "
                              "renderer CI-able")
     args = parser.parse_args(argv)
     try:
@@ -718,6 +770,12 @@ def main(argv=None) -> int:
         if malformed:
             print(f"strict: {len(malformed)} malformed latency/devtime/"
                   f"serving/scenario row(s): " + "; ".join(malformed),
+                  file=sys.stderr)
+            rc = 1
+        specs = spec_mismatches(rows)
+        if specs:
+            print(f"strict: {len(specs)} asset-spec row(s) disagree with "
+                  f"the ledger's ranked winner: " + "; ".join(specs),
                   file=sys.stderr)
             rc = 1
         return rc
