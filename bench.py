@@ -2273,6 +2273,77 @@ def bench_serving_under_load(smoke=False, profile=False):
         res_off = run(None)
         res_on = run(8)
 
+    # ---- round 19: the flight recorder on the SAME overload trace —
+    # recorder-on overhead (interleaved best-of-N, the obs_overhead
+    # bound), 100% span-tree completeness + metering conservation, and a
+    # strict-validated Chrome-trace timeline artifact
+    import contextlib
+
+    from factormodeling_tpu.obs import RunReport
+    from factormodeling_tpu.obs import metering as obs_metering
+
+    def drain(flight=None, report=None):
+        ctx = (report.activate() if report is not None
+               else contextlib.nullcontext())
+        with ctx:
+            res = server.serve_queued(
+                make_requests(configs, arrivals, deadline_s=deadline_s,
+                              tenants=[f"tenant-{i % 8}"
+                                       for i in range(n_requests)]),
+                admission=AdmissionPolicy(max_depth=8),
+                service_model=lambda _tag, _rung: service_s,
+                clock=VirtualClock(), queue_name="serve/queue/flight",
+                flight=flight)
+        _fence(next(iter(res.outputs.values())).summary.total_log_return)
+        return res
+
+    fl_reps = 2 if smoke else 3
+    t_fl_off, t_fl_on = [], []
+    for _ in range(fl_reps):
+        t0 = time.perf_counter()
+        drain()
+        t_fl_off.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        drain(flight=True)
+        t_fl_on.append(time.perf_counter() - t0)
+    flight_overhead = min(t_fl_on) / min(t_fl_off) - 1.0
+
+    # the artifact drain (untimed): rows land on a scratch report, the
+    # timeline exports through the REAL tool, and the tool's own strict
+    # validators judge the artifact — completeness and conservation from
+    # the JSONL alone, exactly what CI would do
+    flight_rep = RunReport("bench/serving_under_load_flight")
+    res_flight = drain(flight=True, report=flight_rep)
+    kit = res_flight.flight
+    assert kit.recorder.complete(), (
+        f"flight span trees incomplete: open traces "
+        f"{kit.recorder.open_traces()[:4]}")
+    conserve = obs_metering.conservation_errors(
+        kit.meter.row("serve/queue/flight/metering"))
+    assert not conserve, conserve
+    os.makedirs(_TRACE_DIR, exist_ok=True)
+    flight_report_path = os.path.join(_TRACE_DIR,
+                                      "serving_under_load_flight.jsonl")
+    flight_rep.write_jsonl(flight_report_path)
+    import importlib.util as _ilu
+
+    spec = _ilu.spec_from_file_location(
+        "_fmt_bench_trace_report",
+        Path(__file__).resolve().parent / "tools" / "trace_report.py")
+    tr = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    rows = tr.load_rows([flight_report_path])
+    timeline_path = os.path.join(_TRACE_DIR,
+                                 "serving_under_load_timeline.json")
+    written = tr.write_timeline(rows, timeline_path)
+    strict_errors = tr.flight_errors(rows) + tr.malformed_rows(rows)
+    assert written is not None and not strict_errors, strict_errors
+    if not smoke:
+        assert flight_overhead <= 0.02, (
+            f"flight-recorder overhead {flight_overhead:.2%} exceeds the "
+            f"2% obs_overhead bound (off {min(t_fl_off):.4f}s on "
+            f"{min(t_fl_on):.4f}s)")
+
     def p99(res):
         v = res.counters.get("served_p99_s")
         return float(v) if v is not None else float("nan")
@@ -2322,6 +2393,19 @@ def bench_serving_under_load(smoke=False, profile=False):
                         "violated_on": bool(p99_on > budget_s)},
                 "shed_rate_on": round(shed_rate_on, 4),
                 "shed_rate_off": round(shed_rate_off, 4),
+                "flight_recorder": {
+                    "overhead_frac": round(flight_overhead, 4),
+                    "overhead_bound": 0.02,
+                    "reps": fl_reps,
+                    "off_s": [round(t, 4) for t in t_fl_off],
+                    "on_s": [round(t, 4) for t in t_fl_on],
+                    "traces": len(kit.recorder.traces),
+                    "trace_complete": True,
+                    "metering_conserved": True,
+                    "pad_fraction": kit.meter.row("m")["pad_fraction"],
+                    "report": flight_report_path,
+                    "timeline": timeline_path,
+                    "strict_validated": True},
                 "counters_on": {k: int(v) for k, v in
                                 res_on.counters.items()
                                 if isinstance(v, int)},

@@ -194,8 +194,9 @@ def _serving_demo(report, say) -> None:
     say(f"  {len(configs)} configs -> {stats['bucket_count']} signature "
         f"buckets, {sum(v['compiles'] for v in serve_cs.values())} "
         f"compiles across {stats['executables']} executables, "
-        f"{stats['dispatches']} dispatches "
-        f"({stats['padded_lanes']} padded lanes), retraced: "
+        f"{stats['logical_dispatches']} dispatches "
+        f"({stats['dispatch_executions']} executions, "
+        f"{stats['padded_lanes']} padded lanes), retraced: "
         f"{sorted(k for k, v in serve_cs.items() if v['retraced'])}")
 
     # ---- loaded serving (the round-15 traffic layer, architecture §21):
@@ -214,18 +215,32 @@ def _serving_demo(report, say) -> None:
     # already compiled, so the loaded leg adds traffic, not compiles
     arrivals = bursty_arrivals(len(traffic), rate_hz=1.5 * 8 / service_s,
                                burst=6, seed=9)
+    # the round-19 flight recorder rides the loaded leg: per-request
+    # causal span trees (kind="reqtrace"), per-tenant cost accounts with
+    # the pad lanes billed to overhead/pad (kind="metering"), and
+    # dispatch-boundary health samples (kind="series") all land in the
+    # report, where trace_report --strict validates completeness and
+    # conservation and report_diff gates cost/pad/depth drift
     res = server.serve_queued(
-        make_requests(traffic, arrivals, deadline_s=8 * service_s),
+        make_requests(traffic, arrivals, deadline_s=8 * service_s,
+                      tenants=[f"tenant-{i % len(configs)}"
+                               for i in range(len(traffic))]),
         admission=AdmissionPolicy(
             max_depth=10,
             ladder=("serve_stale", "cheap_fallback", "reject_new")),
-        service_model=lambda _tag, _rung: service_s)
+        service_model=lambda _tag, _rung: service_s,
+        queue_name="pipeline/serve/queue", flight=True)
     c = res.counters
     say(f"  loaded: {c['submitted']} requests at 1.5x capacity -> "
         f"{c['served']} served / {c['shed_count']} shed / "
         f"{c['deadline_miss_count']} missed / {c['failed_count']} failed "
         f"({c['stale_served']} stale, {c['cheap_fallbacks']} "
         f"cheap-fallback, {c['retry_count']} retries)")
+    meter_row = res.flight.meter.row("pipeline/serve/queue/metering")
+    say(f"  flight: {len(res.flight.recorder.traces)} span trees "
+        f"(complete: {res.flight.recorder.complete()}), "
+        f"{len(meter_row['accounts'])} metering accounts, pad fraction "
+        f"{meter_row['pad_fraction']}")
 
 
 def _scenario_demo(report, say) -> None:
@@ -542,6 +557,19 @@ def run_pipeline(data_dir: str | Path, artifact_dir: str | Path, *,
         say(f"run report: {path} "
             f"(render: python tools/trace_report.py {path}; gate vs a "
             f"baseline: python tools/report_diff.py <baseline> {path})")
+        # the loaded-serving leg's flight traces export as a Chrome-trace
+        # /Perfetto timeline next to the report (the same document
+        # `tools/trace_report.py --timeline` produces)
+        if any(r.get("kind") == "reqtrace" for r in report.rows):
+            import json as _json
+
+            from factormodeling_tpu.obs import reqtrace as _reqtrace
+
+            timeline = Path(str(path) + ".timeline.json")
+            timeline.write_text(
+                _json.dumps(_reqtrace.chrome_trace(report.rows)))
+            say(f"flight timeline: {timeline} (open at chrome://tracing "
+                f"or ui.perfetto.dev)")
     return out
 
 

@@ -46,6 +46,17 @@ sections 13 and 15):
   ``obs.stage`` scopes as ``kind="devtime"`` rows
   (``RunReport.add_devtime``), with an honest skip-with-reason ladder
   on backends whose traces carry no device tracks (CPU).
+- :mod:`~factormodeling_tpu.obs.reqtrace` /
+  :mod:`~factormodeling_tpu.obs.metering` — the round-19 request flight
+  recorder (architecture.md §25): per-request causal span trees on the
+  serving queue's virtual clock (``kind="reqtrace"`` rows, Chrome-trace
+  exportable via ``tools/trace_report.py --timeline``), per-tenant cost
+  accounts with explicit pad/retry overhead billing and artifact-
+  checkable conservation (``kind="metering"``), and the ring-buffered
+  queue-health series (``kind="series"``). Deliberately NOT imported
+  here: both modules load lazily from ``serve_queued(flight=...)`` /
+  ``OnlineEngine(flight=...)`` only, so the default serving paths elide
+  them entirely (the unimportable-module pin in tests/test_reqtrace.py).
 - :mod:`~factormodeling_tpu.obs.report` — ``obs.span(...)`` wall timers
   with built-in ``block_until_ready`` fences, and :class:`RunReport`,
   which merges spans, counter summaries, probe frames, compile rows,

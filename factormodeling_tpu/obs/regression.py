@@ -66,6 +66,25 @@ flags):
   traffic, more shed or missed or retried requests means the serving
   layer (or the hardware under it) got slower or flakier. Decreases and
   other drift are informational; new serving rows are re-baseline notes.
+- **metering** (flight recorder, round 19) — every baseline
+  ``kind="metering"`` row must still exist with every baseline ACCOUNT
+  (per-tenant + the explicit overheads); a tenant account's cost growth
+  beyond ``wall_ratio`` x baseline AND an absolute per-dimension floor
+  (``metering_floor_s`` for seconds; 1 solve / 1 KiB / 1 MiB for the
+  others) is a regression. The serving queue's metered wall is VIRTUAL
+  (the scheduler's deterministic charge, not host time), so this gate
+  stays armed under ``--no-wall`` — a cost drift there is a scheduling/
+  billing change, never machine speed. ``pad_fraction`` growth beyond
+  ``pad_frac_tol`` gates too: the pad account is the amortization-
+  honesty number, and silent growth means the ladder stopped fitting
+  the traffic. Decreases and brand-new rows/accounts are notes.
+- **series** (health series, round 19) — every baseline ``kind="series"``
+  row must still exist, and ``max_depth`` growth beyond ``wall_ratio`` x
+  baseline with ``depth_slack`` absolute headroom is a regression
+  (armed under ``--no-wall``: on the virtual clock the depth profile is
+  a deterministic function of the recorded traffic, so growth is a
+  scheduling regression, not machine speed). ``max_occupancy`` drift is
+  informational.
 - **bench** — bench rows are invocation-dependent (configs are selected
   per run), so presence is never gated; but a seconds-valued bench row
   present in both reports gates its value at ``wall_ratio`` — against
@@ -123,8 +142,16 @@ from pathlib import Path
 __all__ = ["DiffResult", "Finding", "GATE_UP", "bench_rows", "comms_rows",
            "counter_scalars", "devtime_rows", "diff_reports",
            "latency_rows", "load_jsonl", "memory_rows", "meta_row",
-           "numerics_baseline", "online_rows", "scenario_rows",
-           "serving_rows", "sharding_rows", "span_totals"]
+           "metering_rows", "numerics_baseline", "online_rows",
+           "scenario_rows", "series_rows", "serving_rows",
+           "sharding_rows", "span_totals"]
+
+#: absolute per-dimension growth floors of the metering gate — drift
+#: below the floor never gates, whatever the ratio says (a 2x ratio on
+#: a microsecond bill is noise, not a cost regression). wall_s uses the
+#: tunable ``metering_floor_s`` instead.
+METERING_FLOORS = {"qp_solves": 1.0, "iterations": 1.0,
+                   "comms_bytes": 1024.0, "mem_bytes": float(1 << 20)}
 
 #: online-engine counters whose INCREASE against a baseline is a
 #: regression (kind="online" rows; see the module docs' online section)
@@ -340,6 +367,19 @@ def online_verdicts_complete(row) -> bool:
     return sum(parts) == total
 
 
+def metering_rows(rows) -> dict:
+    """name -> last metering row (kind="metering", the round-19 flight
+    recorder's per-tenant cost accounts)."""
+    return {r.get("name", ""): r for r in rows
+            if r.get("kind") == "metering"}
+
+
+def series_rows(rows) -> dict:
+    """name -> last health-series row (kind="series")."""
+    return {r.get("name", ""): r for r in rows
+            if r.get("kind") == "series"}
+
+
 def bench_rows(rows) -> dict:
     """name -> last bench row (kind="bench", keyed by metric name)."""
     return {r.get("metric", r.get("name", "")): r for r in rows
@@ -357,7 +397,10 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
                  comms_min_bytes: float = 1024.0,
                  mem_ratio: float = 1.5,
                  mem_min_bytes: float = 1 << 20,
-                 risk_floor: float = 0.05) -> DiffResult:
+                 risk_floor: float = 0.05,
+                 metering_floor_s: float = 0.005,
+                 pad_frac_tol: float = 0.05,
+                 depth_slack: int = 2) -> DiffResult:
     """Compare a fresh report against a known-good baseline (see module
     docs for the checks). Returns a :class:`DiffResult`; ``not result.ok``
     means gate-failing regressions were found."""
@@ -839,6 +882,103 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
             findings.append(Finding(
                 "online", name, "online-engine row absent from baseline "
                 "(new stream) — re-baseline to gate it"))
+
+    # ---- metering rows (flight recorder, round 19): per-tenant cost
+    # drift gates at ratio + absolute floor, pad-fraction growth gates
+    # at pad_frac_tol. The queue's metered wall is the VIRTUAL charge —
+    # deterministic for a recorded trace — so this section stays armed
+    # under --no-wall: a drift is a scheduling/billing change, never
+    # machine speed.
+    base_mt, new_mt = metering_rows(base_rows), metering_rows(new_rows)
+    for name, base_row in sorted(base_mt.items()):
+        new_row = new_mt.get(name)
+        if new_row is None:
+            findings.append(Finding(
+                "metering", name, "metering row present in baseline, "
+                "missing in new report", regression=True))
+            continue
+        base_acc = base_row.get("accounts") or {}
+        new_acc = new_row.get("accounts") or {}
+        for label in sorted(base_acc):
+            if label not in new_acc:
+                findings.append(Finding(
+                    "metering", f"{name}/{label}",
+                    "account present in baseline, missing in new report "
+                    "— a tenant's bill vanished", regression=True))
+                continue
+            for key, b in sorted(base_acc[label].items()):
+                nv = new_acc[label].get(key)
+                if not isinstance(b, (int, float)) \
+                        or not isinstance(nv, (int, float)):
+                    continue
+                floor = (metering_floor_s if key == "wall_s"
+                         else METERING_FLOORS.get(key, 0.0))
+                growth = nv - b
+                if growth > floor and (b <= 0 or nv / b > wall_ratio):
+                    findings.append(Finding(
+                        "metering", f"{name}/{label}/{key}",
+                        f"metered cost {b:.6g} -> {nv:.6g} "
+                        f"(+{growth:.6g}, > {wall_ratio:g}x with the "
+                        f"{floor:g} absolute floor) — armed under "
+                        f"--no-wall: the charge is virtual, not machine "
+                        f"speed", regression=True))
+                elif growth < -floor:
+                    findings.append(Finding(
+                        "metering", f"{name}/{label}/{key}",
+                        f"metered cost {b:.6g} -> {nv:.6g} (improvement "
+                        f"or restructure — re-baseline to gate it)"))
+        for label in sorted(set(new_acc) - set(base_acc)):
+            findings.append(Finding(
+                "metering", f"{name}/{label}",
+                "account absent from baseline (new tenant/overhead) — "
+                "re-baseline to gate it"))
+        b_pf, n_pf = base_row.get("pad_fraction"), new_row.get("pad_fraction")
+        if isinstance(b_pf, (int, float)) and isinstance(n_pf, (int, float)):
+            if n_pf > b_pf + pad_frac_tol:
+                findings.append(Finding(
+                    "metering", f"{name}/pad_fraction",
+                    f"pad-overhead fraction grew {b_pf:.4f} -> {n_pf:.4f} "
+                    f"(beyond +{pad_frac_tol:g}) — the pad ladder "
+                    f"stopped fitting the traffic", regression=True))
+            elif n_pf != b_pf:
+                findings.append(Finding(
+                    "metering", f"{name}/pad_fraction",
+                    f"pad-overhead fraction {b_pf:.4f} -> {n_pf:.4f} "
+                    f"(within tolerance)"))
+    for name in sorted(set(new_mt) - set(base_mt)):
+        findings.append(Finding(
+            "metering", name, "metering row absent from baseline (new "
+            "recorder scope) — re-baseline to gate it"))
+
+    # ---- health-series rows: max queue depth gates on growth (the
+    # virtual-clock depth profile is deterministic for a recorded trace
+    # — armed under --no-wall like the metering section)
+    base_se, new_se = series_rows(base_rows), series_rows(new_rows)
+    for name, base_row in sorted(base_se.items()):
+        new_row = new_se.get(name)
+        if new_row is None:
+            findings.append(Finding(
+                "series", name, "health-series row present in baseline, "
+                "missing in new report", regression=True))
+            continue
+        b_d, n_d = base_row.get("max_depth"), new_row.get("max_depth")
+        if isinstance(b_d, (int, float)) and isinstance(n_d, (int, float)):
+            if n_d > max(b_d * wall_ratio, b_d + depth_slack):
+                findings.append(Finding(
+                    "series", f"{name}/max_depth",
+                    f"max queue depth {b_d:g} -> {n_d:g} (beyond "
+                    f"{wall_ratio:g}x + {depth_slack:g} slack) — the "
+                    f"backlog profile worsened under the same recorded "
+                    f"traffic", regression=True))
+            elif n_d != b_d:
+                findings.append(Finding(
+                    "series", f"{name}/max_depth",
+                    f"max queue depth {b_d:g} -> {n_d:g} (within "
+                    f"tolerance)"))
+    for name in sorted(set(new_se) - set(base_se)):
+        findings.append(Finding(
+            "series", name, "health-series row absent from baseline "
+            "(new recorder scope) — re-baseline to gate it"))
 
     # ---- bench rows: seconds-valued rows gate at wall_ratio against the
     # spread-aware baseline; presence never gates (configs are selected

@@ -151,6 +151,19 @@ class OnlineEngine:
         history is partial; in-ring rollback-and-replay is unaffected).
       stats_tail / dtype: threaded to
         :func:`~factormodeling_tpu.online.advance.online_step_parts`.
+      flight: the round-19 flight recorder — ``True`` builds a
+        :class:`~factormodeling_tpu.obs.reqtrace.FlightRecorder` (or
+        pass one to share); every ingested tick then gets a causal span
+        tree on the ORDINAL clock (tick ``i`` occupies virtual
+        ``[i, i+1]`` — the engine has no scheduling clock, so the trace
+        time axis is the event index, documented honestly) with the
+        admission decision, the advance (replays as child events per
+        re-applied date), and the terminal verdict. ``flight_rows()``
+        renders them; OFF by default, the module never imports when off
+        (the elision contract). Engine traces are per-process: they do
+        NOT ride the checkpoint (a resumed engine's recorder starts at
+        the resume point) — the byte-equal kill/resume trace contract is
+        the serving queue's, whose snapshot seam the queue kit rides.
     """
 
     def __init__(self, *, names, n_assets: int, template=None,
@@ -158,7 +171,8 @@ class OnlineEngine:
                  guards: EngineGuards | None = None, checkpoint=None,
                  checkpoint_every: int = 1, retain_history: bool = True,
                  checkpoint_history: bool = True,
-                 stats_tail: int = 8, dtype=None, progress=None):
+                 stats_tail: int = 8, dtype=None, progress=None,
+                 flight=None):
         import jax.numpy as jnp
 
         from factormodeling_tpu.composite import prefix_group_ids
@@ -215,6 +229,12 @@ class OnlineEngine:
                          "replay_applied_dates": 0,
                          "full_recompute_fallbacks": 0}
         self.rejected_reasons: dict = {}
+        self._flight = None
+        if flight:
+            from factormodeling_tpu.obs.reqtrace import FlightRecorder
+
+            self._flight = (flight if isinstance(flight, FlightRecorder)
+                            else FlightRecorder())
 
         self._ck = None
         if checkpoint is not None:
@@ -374,7 +394,54 @@ class OnlineEngine:
 
     def ingest(self, date: int, date_slice: DateSlice,
                restate: bool = False) -> OnlineVerdict:
-        """One feed tick -> one terminal verdict (module docs)."""
+        """One feed tick -> one terminal verdict (module docs). With the
+        flight recorder on, every tick additionally terminates in
+        exactly one finished span tree (``flight_rows()``)."""
+        if self._flight is None:
+            return self._ingest_inner(date, date_slice, restate)
+        # the tick's ordinal slot [i, i+1] on the recorder's time axis
+        i = float(self.counters["ingested_dates"])
+        tid = f"tick{int(i)}"
+        fr = self._flight
+        fr.begin(tid, t=i, tenant=str(int(date)), date=int(date),
+                 restate=bool(restate))
+        fr.event(tid, "submit", t=i)
+        verdict = self._ingest_inner(date, date_slice, restate)
+        # the span tree is derived from the verdict AFTER the fact — the
+        # engine's own control flow stays untouched, and every return
+        # path (reject/apply/replay/die-hook aside) lands here exactly
+        # once, which is the completeness invariant's write side
+        if verdict.status == "rejected":
+            fr.event(tid, "reject", t=i + 0.125, reason=verdict.reason)
+        else:
+            fr.event(tid, "admit", t=i + 0.125)
+            sid = fr.open(tid, ("replay" if verdict.status == "replayed"
+                                else "advance"), t=i + 0.25,
+                          replays=len(verdict.replayed_dates) or None)
+            replayed = verdict.replayed_dates
+            for j, d in enumerate(replayed):
+                tj = i + 0.25 + 0.5 * (j + 1) / (len(replayed) + 1)
+                fr.event(tid, "advance", t=tj, parent=sid, date=int(d))
+            fr.close(tid, sid, t=i + 0.75)
+        fr.event(tid, "verdict", t=i + 0.875, verdict=verdict.status,
+                 reason=verdict.reason)
+        fr.finish(tid, verdict.status, t=i + 1.0, date=int(date),
+                  reason=verdict.reason)
+        return verdict
+
+    def flight_rows(self, name: str | None = None) -> list:
+        """The recorder's ``kind="reqtrace"`` rows (empty with the
+        recorder off) — append them to a report next to the
+        ``kind="online"`` rows. ``name`` overrides the default
+        entry-point row name (callers running several engines per report
+        keep their traces distinguishable)."""
+        if self._flight is None:
+            return []
+        return self._flight.rows(name if name is not None
+                                 else f"online/engine/{self._config_tag}")
+
+    def _ingest_inner(self, date: int, date_slice: DateSlice,
+                      restate: bool = False) -> OnlineVerdict:
         date = int(date)
         self.counters["ingested_dates"] += 1
         h = _host_slice(date_slice)

@@ -26,7 +26,13 @@ configurations per dispatch:
   request terminates in exactly one of SERVED/SHED/DEADLINE_MISS/FAILED
   (``TenantServer.serve_queued``). Imported LAZILY (PEP 562 below): the
   default synchronous path never loads these modules, the structural-
-  elision contract pinned in tests/test_serve_queue.py.
+  elision contract pinned in tests/test_serve_queue.py. Round 19 adds
+  the opt-in request FLIGHT RECORDER (``serve_queued(flight=True)``,
+  architecture.md §25): per-request causal span trees on the virtual
+  clock, per-tenant cost metering with explicit pad/retry overhead
+  accounts, and dispatch-boundary health series — its ``obs.reqtrace``
+  / ``obs.metering`` modules elide under the same contract
+  (tests/test_reqtrace.py).
 """
 
 from factormodeling_tpu.serve.batched import (  # noqa: F401
@@ -50,9 +56,9 @@ from factormodeling_tpu.serve.tenant import (  # noqa: F401
 #: the default synchronous path structurally elides
 _LAZY = {
     "queue": ("DEADLINE_MISS", "FAILED", "SERVED", "SHED", "VERDICTS",
-              "DispatchEstimator", "QueueResult", "Request", "VirtualClock",
-              "bursty_arrivals", "make_requests", "poisson_arrivals",
-              "run_queued"),
+              "DispatchEstimator", "FlightKit", "QueueResult", "Request",
+              "VirtualClock", "bursty_arrivals", "make_requests",
+              "poisson_arrivals", "run_queued"),
     "admission": ("AdmissionPolicy", "LADDER_STEPS", "StaleCache"),
 }
 _LAZY_NAME_TO_MOD = {name: mod for mod, names in _LAZY.items()

@@ -169,11 +169,19 @@ class TenantServer:
         if donate_configs is None:
             donate_configs = jax.default_backend() != "cpu"
         self._donate = bool(donate_configs)
-        # serving tallies (streaming_cache_stats-style; see serving_stats)
+        # serving tallies (streaming_cache_stats-style; see serving_stats).
+        # dispatch_executions counts every executable invocation (the
+        # queue's poisoned-then-retried attempts included);
+        # logical_dispatches counts scheduling decisions (one per serve()
+        # chunk, per queued logical dispatch, per advance_all bucket) —
+        # the round-19 split of the executions-vs-logical ambiguity into
+        # two explicit counters (executions exceed logical dispatches by
+        # the faulted attempts that reached the executable)
         self._buckets_seen: set = set()
         self._executables_seen: set = set()
-        self._stats = {"dispatches": 0, "configs_served": 0,
-                       "padded_lanes": 0, "rejected_configs": 0}
+        self._stats = {"dispatch_executions": 0, "logical_dispatches": 0,
+                       "configs_served": 0, "padded_lanes": 0,
+                       "rejected_configs": 0}
 
     # --------------------------------------------------------- sharding
 
@@ -366,11 +374,17 @@ class TenantServer:
         the caller, so the synchronous row shape is untouched by the
         queue sharing this path.
 
-        ``serving_stats()`` counts EXECUTIONS: under the queue's retry
-        wrapper a poisoned-then-retried dispatch runs this twice for one
-        logical dispatch, so these tallies can legitimately exceed the
-        queue's ``kind="serving"`` row (which counts logical dispatches
-        and delivered verdicts) by exactly the faulted attempts."""
+        This tallies ``dispatch_executions`` — every executable
+        invocation, the queue's poisoned-then-retried attempts included;
+        the matching scheduling decision tallies ``logical_dispatches``
+        at its own site (:meth:`serve`'s chunk loop, the queue's
+        dispatch-completion hook, :meth:`advance_all`), so
+        ``serving_stats()`` reports BOTH counters explicitly. Their
+        difference is the extra attempts that REACHED the executable
+        (``dispatch_poison`` completes then fails validation); a
+        ``dispatch_error`` fault raises before this method runs, so such
+        attempts appear in neither counter (pinned in
+        tests/test_reqtrace.py)."""
         self._buckets_seen.add(skey)
         pad = rung - len(lanes)
         lanes = list(lanes) + [lanes[-1]] * pad  # discarded at demux
@@ -378,10 +392,16 @@ class TenantServer:
         name, exe = self._executable(skey, rung, template)
         self._executables_seen.add(name)
         out = exe(stacked, *self._panels)
-        self._stats["dispatches"] += 1
+        self._stats["dispatch_executions"] += 1
         self._stats["configs_served"] += rung - pad
         self._stats["padded_lanes"] += pad
         return name, out, pad
+
+    def _note_logical_dispatch(self) -> None:
+        """One scheduling decision completed (the queue's hook — any
+        poisoned retries within it already counted as executions;
+        error-faulted attempts never reached the executable at all)."""
+        self._stats["logical_dispatches"] += 1
 
     def serve(self, configs) -> list[TenantResult]:
         """Validate, bucket, pad, dispatch, demux (module docs). Returns
@@ -411,6 +431,7 @@ class TenantServer:
                 lanes = [normalized[i] for i in chunk]
                 name, out, pad = self._dispatch_padded(skey, rung, lanes,
                                                        template)
+                self._note_logical_dispatch()
                 record_stage("serve/dispatch", kind="stage",
                              entry_point=name, rung=rung,
                              configs=len(chunk), padded_lanes=pad,
@@ -574,26 +595,54 @@ class TenantServer:
                                                name=name,
                                                expected_signatures=1)
 
-    def advance_all(self, date_slice) -> "list[TenantAdvance]":
+    def advance_all(self, date_slice, *, date=None,
+                    meter=None) -> "list[TenantAdvance]":
         """Advance EVERY tenant of every bucket by one arriving date —
         one vmapped dispatch per bucket over the stacked state pytrees
         (:meth:`online_begin` docs). Returns one :class:`TenantAdvance`
         per submitted config, in submission order; ``output.ready`` is
-        False on the very first date (nothing finalized yet)."""
+        False on the very first date (nothing finalized yet).
+
+        ``meter`` (round 19): a
+        :class:`~factormodeling_tpu.obs.metering.CostMeter` — each
+        bucket dispatch's FENCED wall is then measured and split across
+        the rung's lanes into a per-(bucket, ``date``) account (pad
+        lanes billed to ``overhead/pad``, the same honesty rule as the
+        queue); ``date`` labels the account (defaults to the session's
+        advance ordinal). With ``meter=None`` (the default) no wall is
+        measured and no fence is added — the advance path is untouched."""
         if not getattr(self, "_online", None):
             raise RuntimeError("advance_all before online_begin — open an "
                                "online session first")
         if self.mesh is not None:
             date_slice = self._shard_date_slice(date_slice)
+        if date is None:
+            date = getattr(self, "_advance_ordinal", 0)
+        self._advance_ordinal = getattr(self, "_advance_ordinal", 0) + 1
         results: list = [None] * len(self._online_configs)
         for skey, session in self._online.items():
             name, exe = self._online_executable(session)
             self._executables_seen.add(name)
+            if meter is not None:
+                import time
+
+                t0 = time.perf_counter()
             mstate2, tstates2, outs = exe(
                 session["stacked"], session["mstate"],
                 session["tstates"], date_slice)
+            if meter is not None:
+                # fence INSIDE the window: the dispatch returns before a
+                # single lane has computed, and billing dispatch-only
+                # walls would be the async-timing bug the lint exists for
+                jax.block_until_ready(outs)
+                wall = time.perf_counter() - t0
+                rung = session["rung"]
+                account = f"{name}@{date}"
+                meter.charge([account] * len(session["members"]), rung,
+                             wall_s=wall)
             session["mstate"], session["tstates"] = mstate2, tstates2
-            self._stats["dispatches"] += 1
+            self._stats["dispatch_executions"] += 1
+            self._stats["logical_dispatches"] += 1
             self._stats["configs_served"] += len(session["members"])
             self._stats["padded_lanes"] += session["pad"]
             record_stage("online/advance", kind="stage",
@@ -612,7 +661,13 @@ class TenantServer:
     def serving_stats(self) -> dict:
         """streaming_cache_stats-style serving tallies: ``bucket_count``
         (distinct signature buckets seen), ``executables`` ((bucket, rung)
-        entry points), dispatch/config/pad counts, the ladder, and the
+        entry points), the explicit ``dispatch_executions`` vs
+        ``logical_dispatches`` pair (executions count every executable
+        invocation while logical dispatches count scheduling decisions;
+        executions exceed logical dispatches by the faulted attempts
+        that REACHED the executable — ``dispatch_poison`` retries — and
+        ``dispatch_error`` attempts, which raise before dispatching,
+        appear in neither), config/pad counts, the ladder, and the
         shared kernel-cache counters the executables live in."""
         return {"bucket_count": len(self._buckets_seen),
                 "executables": len(self._executables_seen),

@@ -85,9 +85,9 @@ from factormodeling_tpu.serve.admission import (
 from factormodeling_tpu.serve.tenant import TenantConfig, stack_configs
 
 __all__ = ["DEADLINE_MISS", "FAILED", "SERVED", "SHED", "VERDICTS",
-           "DispatchEstimator", "QueueResult", "Request", "VirtualClock",
-           "bursty_arrivals", "make_requests", "poisson_arrivals",
-           "run_queued"]
+           "DispatchEstimator", "FlightKit", "QueueResult", "Request",
+           "VirtualClock", "bursty_arrivals", "make_requests",
+           "poisson_arrivals", "run_queued"]
 
 #: the verdict state machine's four terminal states — every submitted
 #: request ends in exactly one (the loop asserts the counts sum)
@@ -167,33 +167,58 @@ def bursty_arrivals(n: int, *, rate_hz: float, burst: int = 8,
 
 @dataclasses.dataclass(frozen=True)
 class Request:
-    """One unit of traffic: who (``rid``), what (``config``), when it
-    arrived, and the ABSOLUTE virtual deadline by which the answer is
-    worth having."""
+    """One unit of traffic: who (``rid`` positionally, ``tenant`` stably),
+    what (``config``), when it arrived, and the ABSOLUTE virtual deadline
+    by which the answer is worth having.
+
+    ``tenant`` (round-19 satellite) is the STABLE identity label the
+    metering accounts and verdict rows key on — a positional ``rid`` is
+    meaningless across runs, so billing or debugging by rid cannot
+    survive a re-submission. Defaults to ``str(rid)`` (:meth:`label`)
+    for callers that have no identity to offer."""
 
     rid: int
     config: TenantConfig
     arrival_s: float
     deadline_s: float
+    tenant: "str | None" = None
 
     def __post_init__(self):
         if not (self.deadline_s > self.arrival_s):
             raise ValueError(
                 f"request {self.rid}: deadline {self.deadline_s!r} must be "
                 f"after arrival {self.arrival_s!r}")
+        if self.tenant is not None and not str(self.tenant):
+            raise ValueError(f"request {self.rid}: tenant label must be "
+                             f"a non-empty string or None")
+
+    @property
+    def label(self) -> str:
+        """The stable tenant label (``tenant``, else ``str(rid)``)."""
+        return str(self.tenant) if self.tenant is not None else str(self.rid)
 
 
-def make_requests(configs, arrivals, *, deadline_s: float) -> list:
+def make_requests(configs, arrivals, *, deadline_s: float,
+                  tenants=None) -> list:
     """Zip configs with an arrival trace under one relative deadline
-    budget; rids are positional."""
+    budget; rids are positional, ``tenants`` optionally labels each
+    request with its stable identity (metering/verdict key)."""
     arrivals = np.asarray(arrivals, dtype=float)
     configs = list(configs)
     if len(configs) != arrivals.shape[0]:
         raise ValueError(f"{len(configs)} configs vs "
                          f"{arrivals.shape[0]} arrival times")
+    if tenants is None:
+        tenants = [None] * len(configs)
+    else:
+        tenants = [None if t is None else str(t) for t in tenants]
+        if len(tenants) != len(configs):
+            raise ValueError(f"{len(configs)} configs vs "
+                             f"{len(tenants)} tenant labels")
     return [Request(rid=i, config=c, arrival_s=float(t),
-                    deadline_s=float(t) + float(deadline_s))
-            for i, (c, t) in enumerate(zip(configs, arrivals))]
+                    deadline_s=float(t) + float(deadline_s), tenant=lbl)
+            for i, (c, t, lbl) in enumerate(zip(configs, arrivals,
+                                                tenants))]
 
 
 # ------------------------------------------------------- dispatch estimate
@@ -276,6 +301,7 @@ class QueueResult(NamedTuple):
     outputs: dict       # rid -> ResearchOutput lane (SERVED + DEADLINE_MISS)
     counters: dict      # the kind="serving" row's counts
     clock_s: float      # virtual makespan (last event time)
+    flight: object = None  # the FlightKit when the recorder ran, else None
 
     def by_rid(self) -> dict:
         return {v["rid"]: v for v in self.verdicts}
@@ -317,6 +343,68 @@ def _sketch_restore(state: dict) -> QuantileSketch:
     return sk
 
 
+# ----------------------------------------------------- flight recorder kit
+
+
+class FlightKit:
+    """The round-19 request flight recorder's three instruments, bundled
+    for the queue: the per-request causal span recorder
+    (:class:`~factormodeling_tpu.obs.reqtrace.FlightRecorder`), the
+    per-tenant cost meter
+    (:class:`~factormodeling_tpu.obs.metering.CostMeter`), and the
+    virtual-clock health series
+    (:class:`~factormodeling_tpu.obs.reqtrace.HealthSeries`). Built only
+    when ``run_queued(flight=...)`` asks for it — the modules import
+    lazily HERE, so the default queue path (and the synchronous serve
+    path) never touches them: the PR 7 unimportable-module elision
+    contract, pinned in tests/test_reqtrace.py. State rides the queue's
+    checkpoint seam as one JSON string, so a killed-and-resumed run's
+    trace log is byte-equal to a straight-through run's."""
+
+    def __init__(self, *, series_cap: int = 512):
+        from factormodeling_tpu.obs.metering import CostMeter
+        from factormodeling_tpu.obs.reqtrace import (FlightRecorder,
+                                                     HealthSeries)
+
+        self.recorder = FlightRecorder()
+        self.meter = CostMeter()
+        self.series = HealthSeries(cap=series_cap)
+        self.wait_sids: dict = {}  # rid -> open queue/wait span id
+        # entry_name -> {comms_bytes, mem_bytes} memo: the ledger rows
+        # for one entry point are written once (on its compile, which
+        # precedes its first metered dispatch), and rescanning the whole
+        # report per dispatch would make metered drains quadratic in
+        # dispatch count (review finding). Not snapshotted: a resumed
+        # run rebuilds the memo from its own report.
+        self.ledger_memo: dict = {}
+
+    def rows(self, queue_name: str) -> list:
+        """Every flight row this kit would contribute to a report: the
+        per-trace ``kind="reqtrace"`` rows (named like the queue, so the
+        strict count-vs-submissions cross-check can find them), the
+        ``kind="metering"`` accounts row, and the ``kind="series"``
+        health row."""
+        return (self.recorder.rows(queue_name)
+                + [self.meter.row(f"{queue_name}/metering"),
+                   self.series.row(f"{queue_name}/health")])
+
+    def state(self) -> str:
+        return json.dumps(
+            {"trace": self.recorder.state(), "meter": self.meter.state(),
+             "series": self.series.state(),
+             "wait": {str(rid): sid
+                      for rid, sid in self.wait_sids.items()}},
+            sort_keys=True)
+
+    def load_state(self, state: str) -> None:
+        doc = json.loads(state)
+        self.recorder.load_state(doc["trace"])
+        self.meter.load_state(doc["meter"])
+        self.series.load_state(doc["series"])
+        self.wait_sids = {int(rid): int(sid)
+                          for rid, sid in doc.get("wait", {}).items()}
+
+
 # ------------------------------------------------------------- the loop
 
 
@@ -330,7 +418,7 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                retry_backoff_s: float = 0.001, flush_headroom_s: float = 0.0,
                clock=None, seed_latency=None, checkpoint_path=None,
                checkpoint_every: int = 1, queue_name: str = "serve/queue",
-               _stop_after_dispatches=None) -> QueueResult:
+               flight=None, _stop_after_dispatches=None) -> QueueResult:
     """Drain ``requests`` through ``server`` under the traffic layer
     (module docs). Prefer calling it as
     :meth:`~factormodeling_tpu.serve.frontend.TenantServer.serve_queued`.
@@ -345,6 +433,19 @@ def run_queued(server, requests, *, admission=None, service_model=None,
     estimator — the PR 8 artifact closing the loop into scheduling.
     ``queue_name``: the ``kind="serving"`` summary row's name (distinct
     names keep multiple queue runs per report individually gateable).
+    ``flight``: the round-19 flight recorder — ``True`` builds a fresh
+    :class:`FlightKit` (an existing kit is accepted to accumulate
+    accounts across runs, but trace ids are rids — two drains sharing a
+    kit must not reuse rids, or ``begin`` rejects the duplicate); every
+    request then gets a causal span tree on the virtual clock
+    (``kind="reqtrace"`` rows), every dispatch's cost splits into
+    per-tenant accounts with the pad lanes billed to ``overhead/pad``
+    (``kind="metering"``), and queue health samples at every dispatch
+    boundary (``kind="series"``). OFF by default: ``flight=None`` never
+    imports ``obs.reqtrace`` / ``obs.metering`` (elision pin), and the
+    kit's state rides the checkpoint so a resumed run's trace log is
+    byte-equal to straight-through. The kit returns on
+    ``QueueResult.flight``.
     ``_stop_after_dispatches``: test seam — return the PARTIAL result
     right after that many dispatches have snapshotted (the in-process
     half of the kill/resume differential; the out-of-process half is the
@@ -359,6 +460,11 @@ def run_queued(server, requests, *, admission=None, service_model=None,
     admission = admission if admission is not None else AdmissionPolicy()
     clock = clock if clock is not None else VirtualClock()
     estimator = estimator if estimator is not None else DispatchEstimator()
+    # the flight recorder is OPT-IN and lazily built: flight=None (the
+    # default) never imports obs.reqtrace/obs.metering — the elision pin
+    kit = None
+    if flight:
+        kit = flight if isinstance(flight, FlightKit) else FlightKit()
     ladder = server.pad_ladder
     top = ladder[-1]
     n = len(requests)
@@ -407,7 +513,14 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                    "retries": int(retries),
                    "retry_backoff_s": float(retry_backoff_s),
                    "flush_headroom_s": float(flush_headroom_s),
-                   "fault_plan": repr(fault_plan)}
+                   "fault_plan": repr(fault_plan),
+                   **({"flight": True} if kit is not None else {})}
+        # recorder ON joins the guard (resuming a flight-on snapshot
+        # without the kit — or vice versa — would silently drop the
+        # trace log's prefix), but flight-OFF runs deliberately omit
+        # the key: emitting "flight": False would invalidate every
+        # snapshot written before round 19 for runs whose actual
+        # configuration is unchanged (review finding)
         ck = _ckpt.Checkpointer(checkpoint_path, every=checkpoint_every)
         got = ck.resume(expect_meta=ck_meta)
         if got is not None:
@@ -426,6 +539,8 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             sketches = {name: _sketch_restore(s)
                         for name, s in state["sketches"].items()}
             stale.load_state(state["stale"])
+            if kit is not None and "flight" in state:
+                kit.load_state(str(state["flight"]))
             for skey, items in state["pending"]:
                 # bucket keys restore in snapshot order, EMPTY buckets
                 # included — dispatch-order determinism across a resume
@@ -441,7 +556,7 @@ def run_queued(server, requests, *, admission=None, service_model=None,
     def verdict(rid: int, kind: str, *, done_s: float, rung=None,
                 dispatch=None, detail: str = "") -> None:
         r = req_by_rid[rid]
-        row = {"rid": int(rid), "verdict": kind,
+        row = {"rid": int(rid), "tenant": r.label, "verdict": kind,
                "arrival_s": _round(r.arrival_s),
                "deadline_s": _round(r.deadline_s),
                "done_s": _round(done_s),
@@ -451,6 +566,11 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                "detail": detail}
         verdict_log.append(row)
         verdict_lines.append(json.dumps(row, sort_keys=True))
+        if kit is not None:
+            kit.recorder.event(str(rid), "verdict", t=done_s,
+                               verdict=kind, detail=detail or None)
+            kit.recorder.finish(str(rid), kind, t=done_s,
+                                rid=int(rid), detail=detail or None)
         done.add(rid)
         key = {SERVED: "served", SHED: "shed_count",
                DEADLINE_MISS: "deadline_miss_count",
@@ -486,7 +606,14 @@ def run_queued(server, requests, *, admission=None, service_model=None,
         """The admission decision at (virtual) arrival processing time:
         enqueue, or walk the policy's degrade ladder (admission module
         docs) — every path ends in an enqueue or a terminal verdict."""
+        if kit is not None:
+            kit.recorder.begin(str(r.rid), t=r.arrival_s, tenant=r.label,
+                               rid=int(r.rid))
+            kit.recorder.event(str(r.rid), "submit", t=r.arrival_s)
         if r.rid in invalid:
+            if kit is not None:
+                kit.recorder.event(str(r.rid), "reject", t=clock.now_s,
+                                   reason=invalid[r.rid])
             verdict(r.rid, FAILED, done_s=clock.now_s,
                     detail=f"rejected: {invalid[r.rid]}")
             return
@@ -495,6 +622,11 @@ def run_queued(server, requests, *, admission=None, service_model=None,
         if reason is None:
             skey = normalized[r.rid].static_key()
             pending.setdefault(skey, []).append(_Pending(r.rid, False))
+            if kit is not None:
+                kit.recorder.event(str(r.rid), "admit", t=clock.now_s,
+                                   bucket=repr(skey))
+                kit.wait_sids[r.rid] = kit.recorder.open(
+                    str(r.rid), "queue/wait", t=clock.now_s)
             return
         for step in admission.ladder:
             if step == SERVE_STALE:
@@ -508,6 +640,10 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                     stale.put(key, source_rid, out)
                     outputs[r.rid] = out
                     counters["stale_served"] += 1
+                    if kit is not None:
+                        kit.recorder.event(
+                            str(r.rid), "stale", t=clock.now_s,
+                            reason=reason, source_rid=int(source_rid))
                     # a stale answer delivered past the deadline is still
                     # a miss — the dispatch path's rule, applied here too
                     kind = (SERVED if clock.now_s <= r.deadline_s
@@ -527,10 +663,22 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                     pending.setdefault(skey, []).append(
                         _Pending(r.rid, True))
                     counters["cheap_fallbacks"] += 1
+                    if kit is not None:
+                        kit.recorder.event(
+                            str(r.rid), "cheap_fallback", t=clock.now_s,
+                            reason=reason, bucket=repr(skey))
+                        kit.wait_sids[r.rid] = kit.recorder.open(
+                            str(r.rid), "queue/wait", t=clock.now_s)
                     return
             elif step == REJECT_NEW:
+                if kit is not None:
+                    kit.recorder.event(str(r.rid), "shed", t=clock.now_s,
+                                       reason=reason)
                 verdict(r.rid, SHED, done_s=clock.now_s, detail=reason)
                 return
+        if kit is not None:
+            kit.recorder.event(str(r.rid), "shed", t=clock.now_s,
+                               reason=f"{reason}; no ladder step applied")
         verdict(r.rid, SHED, done_s=clock.now_s,
                 detail=f"{reason}; no ladder step applied")
 
@@ -611,25 +759,60 @@ def run_queued(server, requests, *, admission=None, service_model=None,
         if chunk_deadline <= clock.now_s:
             chunk_deadline = None
 
+        # batch formation: close each member's queue-wait span and open
+        # the SHARED dispatch span — same dispatch index, rung, pad
+        # fraction, and member list in every member's tree (the causal
+        # link the flight recorder exists for)
+        d_sids: dict = {}
+        attempt_log: list = []
+        if kit is not None:
+            t_form = clock.now_s
+            pad_f = (rung - len(chunk)) / rung
+            members = [str(p.rid) for p in chunk]
+            for p in chunk:
+                wsid = kit.wait_sids.pop(p.rid, None)
+                if wsid is not None:
+                    kit.recorder.close(str(p.rid), wsid, t=t_form,
+                                       bucket=tag)
+                d_sids[p.rid] = kit.recorder.open(
+                    str(p.rid), "dispatch", t=t_form,
+                    dispatch=dispatch_idx, rung=int(rung),
+                    pad_fraction=round(pad_f, 6),
+                    downgraded=bool(downgraded),
+                    degraded=bool(p.degraded), members=members)
+
         def one_attempt():
             nonlocal attempt_counter
             k = attempt_counter
             attempt_counter += 1
+            t0 = clock.now_s
             clock.advance(service)
             fault = fault_plan.roll(k) if fault_plan is not None else None
             if fault == "dispatch_error":
                 counters["dispatch_faults"] += 1
+                attempt_log.append((k, t0, clock.now_s, fault))
                 raise DispatchFault("dispatch_error", k)
             out = server._dispatch_padded(skey, rung, lanes, template)
             if fault == "dispatch_poison":
                 # the dispatch "completed" but its outputs fail validation
                 # and are discarded — distinct class, same retry path
                 counters["dispatch_faults"] += 1
+                attempt_log.append((k, t0, clock.now_s, fault))
                 raise DispatchFault("dispatch_poison", k)
+            attempt_log.append((k, t0, clock.now_s, None))
             return out
 
         def count_retry(_attempt, _exc, _delay):
             counters["retry_count"] += 1
+
+        def flight_attempts(rid) -> None:
+            # retries as child spans of the dispatch span, reusing the
+            # resil attempt indices
+            for k, a0, a1, fault in attempt_log:
+                sid = kit.recorder.open(str(rid), "attempt", t=a0,
+                                        parent=d_sids[rid],
+                                        attempt=int(k), fault=fault)
+                kit.recorder.close(str(rid), sid, t=a1)
 
         try:
             name, out, pad = retry_call(
@@ -639,17 +822,46 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                 clock=lambda: clock.now_s, sleep=clock.advance,
                 on_retry=count_retry)
         except DispatchFault as e:
+            if kit is not None:
+                for p in chunk:
+                    flight_attempts(p.rid)
+                    kit.recorder.close(str(p.rid), d_sids[p.rid],
+                                       t=clock.now_s, error=str(e))
+                # every attempt burned service time and delivered
+                # nothing: all of it is explicit overhead, not a bill
+                for _k, _a0, _a1, _fault in attempt_log:
+                    kit.meter.overhead("overhead/failed", wall_s=service)
             for p in chunk:
                 verdict(p.rid, FAILED, done_s=clock.now_s, rung=rung,
                         dispatch=dispatch_idx,
                         detail=f"dispatch failed after retries: {e}")
             _remove_from_pending(skey, chunk)
+            _sample_health(len(chunk), rung)
             _finish_dispatch(skey, rung, None, downgraded)
             return
 
         t_done = clock.now_s
         estimator.observe(tag, rung, service)
         counters["padded_lanes"] += pad
+        if kit is not None:
+            for p in chunk:
+                flight_attempts(p.rid)
+                kit.recorder.close(str(p.rid), d_sids[p.rid], t=t_done)
+                kit.recorder.event(str(p.rid), "demux", t=t_done)
+            # metering: the successful attempt's cost splits across the
+            # rung's lanes (pad lanes -> overhead/pad); earlier failed
+            # attempts are explicit retry overhead
+            for _ in attempt_log[:-1]:
+                kit.meter.overhead("overhead/retry", wall_s=service)
+            qp = _qp_per_lane(out, rung)
+            if name not in kit.ledger_memo:
+                kit.ledger_memo[name] = _ledger_costs(name)
+            kit.meter.charge(
+                [req_by_rid[p.rid].label for p in chunk], rung,
+                wall_s=service,
+                per_lane=None if qp is None else {"qp_solves": qp},
+                **({"qp_solves": 0.0} if qp is not None else {}),
+                **kit.ledger_memo[name])
         stale_enabled = SERVE_STALE in admission.ladder
         for lane, p in enumerate(chunk):
             out_lane = _tree_lane(out, lane)
@@ -666,12 +878,27 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                      entry_point=name, rung=rung, configs=len(chunk),
                      padded_lanes=pad, downgraded=bool(downgraded),
                      virtual_t_s=_round(t_done))
+        _sample_health(len(chunk), rung)
         _finish_dispatch(skey, rung, name, downgraded)
+
+    def _sample_health(chunk_len: int, rung: int) -> None:
+        # health series sample at the dispatch boundary — BEFORE the
+        # checkpoint in _finish_dispatch, so it rides the snapshot
+        if kit is None:
+            return
+        kit.series.sample(
+            t=clock.now_s, depth=depth(),
+            occupancy=chunk_len / rung,
+            shed_rate=counters["shed_count"] / max(1, arr_idx),
+            served_p99_s=served_p99())
 
     def _finish_dispatch(skey, rung, name, downgraded) -> None:
         nonlocal dispatch_idx
         global _dispatch_tally
         counters["dispatches"] += 1
+        note = getattr(server, "_note_logical_dispatch", None)
+        if note is not None:
+            note()
         if downgraded:
             counters["rung_downgrades"] += 1
         dispatch_idx += 1
@@ -695,16 +922,23 @@ def run_queued(server, requests, *, admission=None, service_model=None,
         # scalar trees, which the snapshot codec round-trips exactly.
         pend = [(skey, [[p.rid, p.degraded] for p in items])
                 for skey, items in pending.items()]
-        return {"verdict_log": list(verdict_lines),
-                "clock_s": np.asarray(clock.now_s, np.float64),
-                "arr_idx": arr_idx, "attempt_counter": attempt_counter,
-                "dispatch_idx": dispatch_idx,
-                "estimator": estimator.state(),
-                "counters": {k: int(v) for k, v in counters.items()},
-                "sketches": {nm: _sketch_state(sk)
-                             for nm, sk in sketches.items()},
-                "stale": stale.state(flatten=_flatten_output),
-                "pending": pend}
+        state = {"verdict_log": list(verdict_lines),
+                 "clock_s": np.asarray(clock.now_s, np.float64),
+                 "arr_idx": arr_idx, "attempt_counter": attempt_counter,
+                 "dispatch_idx": dispatch_idx,
+                 "estimator": estimator.state(),
+                 "counters": {k: int(v) for k, v in counters.items()},
+                 "sketches": {nm: _sketch_state(sk)
+                              for nm, sk in sketches.items()},
+                 "stale": stale.state(flatten=_flatten_output),
+                 "pending": pend}
+        if kit is not None:
+            # the flight recorder rides the SAME snapshot seam: a
+            # resumed run's trace log must be byte-equal to a
+            # straight-through run's (one JSON string — cheap to encode,
+            # and exact floats inside)
+            state["flight"] = kit.state()
+        return state
 
     # ------------------------------------------------------ the event loop
     while True:
@@ -756,8 +990,53 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             for scope, sk in sketches.items():
                 rep.latency.sketches.setdefault(
                     scope, QuantileSketch()).merge(sk)
+        if rep is not None and kit is not None:
+            # the flight rows land only on a COMPLETE drain — a partial
+            # trace set is exactly the orphan shape --strict rejects
+            rep.rows.extend(kit.rows(queue_name))
     return QueueResult(verdicts=verdict_log, outputs=outputs,
-                       counters=row, clock_s=clock.now_s)
+                       counters=row, clock_s=clock.now_s, flight=kit)
+
+
+# --------------------------------------------------- flight cost sources
+
+
+def _qp_per_lane(out, rung: int):
+    """Per-lane QP solve counts from the dispatch output's
+    ``SolverDiagnostics`` (the StageCounters rail), or None when the
+    output does not carry them in the expected ``[rung]`` shape — the
+    metering contract is "when available", never a crash."""
+    try:
+        qp = np.asarray(out.sim.diagnostics.qp_solves)
+    except Exception:
+        return None
+    if qp.shape != (rung,):
+        return None
+    return [float(v) for v in qp]
+
+
+def _ledger_costs(entry_name: str) -> dict:
+    """Comms/memory byte estimates for one entry point from the PR 5
+    placement ledger, when the active report collected them (the
+    ``RunReport(comms=True)`` path) — per-dispatch amortized costs the
+    meter splits like the wall."""
+    rep = active_report()
+    if rep is None:
+        return {}
+    comms = mem = None
+    for r in rep.rows:
+        if r.get("name") != entry_name:
+            continue
+        if r.get("kind") == "comms" and r.get("stage") == "total":
+            comms = r.get("bytes_moved")
+        elif r.get("kind") == "memory":
+            mem = r.get("peak_bytes")
+    out = {}
+    if isinstance(comms, (int, float)):
+        out["comms_bytes"] = float(comms)
+    if isinstance(mem, (int, float)):
+        out["mem_bytes"] = float(mem)
+    return out
 
 
 # ----------------------------------------------------- pytree lane helpers

@@ -46,7 +46,14 @@ def test_default_targets_cover_examples_and_obs_layer():
             # round 13: the latency-SLO modules — devtime.py and the
             # instrument_jit recorder path own perf_counter windows whose
             # fences are the recorder's whole claim
-            "latency.py", "devtime.py"} <= names
+            "latency.py", "devtime.py",
+            # round 19: the flight recorder rides the obs glob — pinned
+            # by name because reqtrace.py's whole claim is that trace
+            # time is VIRTUAL (an ambient perf_counter there would
+            # re-couple span trees to host jitter) and metering.py's
+            # billed walls must come from fenced or virtual sources,
+            # never an ad-hoc unfenced window
+            "reqtrace.py", "metering.py"} <= names
     dirs = {p.parent.name for p in targets}
     assert {"examples", "obs", "tools"} <= dirs
 

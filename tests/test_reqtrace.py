@@ -1,0 +1,639 @@
+"""The round-19 request flight recorder (``obs/reqtrace.py`` +
+``obs/metering.py``, docs/architecture.md §25) threaded through the
+serving queue and the online engine.
+
+Contract pinned here:
+
+- **span-tree completeness** (the acceptance criterion): under the PR 10
+  bursty-overload-with-dispatch-faults trace, EVERY submitted request —
+  SERVED, SHED, DEADLINE_MISS, and FAILED alike — owns exactly one
+  finished, fully closed, properly nested span tree, and retried
+  dispatches appear as ``attempt`` child spans reusing the resil attempt
+  indices;
+- **metering conservation**: per-tenant accounts plus the explicit
+  ``overhead/pad`` / ``overhead/retry`` / ``overhead/failed`` accounts
+  sum back to the measured dispatch totals to float tolerance, accounts
+  key on the stable ``Request.tenant`` label (satellite), and
+  ``advance_all`` meters per-(bucket, date);
+- **kill/resume**: the kit's state rides the existing queue snapshot
+  seam — a run stopped mid-drain and resumed produces a trace log
+  BYTE-equal to an uninterrupted run's;
+- **structural elision**: with ``obs.reqtrace`` and ``obs.metering``
+  made unimportable, ``serve()`` and ``run_queued`` (without ``flight``)
+  still work bit-identically — the default paths never import the
+  recorder;
+- **serving_stats split** (satellite): ``dispatch_executions`` vs
+  ``logical_dispatches`` are two explicit counters; retried/poisoned
+  attempts count executions only;
+- **artifact gates**: ``trace_report --strict`` fails unclosed/
+  overlapping span trees, orphan trace ids, and non-conserving metering
+  rows; ``--timeline`` exports a Chrome-trace document; ``report_diff``
+  gates per-tenant cost drift, pad-fraction growth, and max-queue-depth
+  growth — all armed under ``--no-wall``.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from factormodeling_tpu import obs
+from factormodeling_tpu.obs import metering, reqtrace
+from factormodeling_tpu.obs.regression import diff_reports
+from factormodeling_tpu.resil import DispatchFaultPlan
+from factormodeling_tpu.serve import TenantConfig, TenantServer
+from factormodeling_tpu.serve.admission import AdmissionPolicy
+from factormodeling_tpu.serve.queue import (
+    FlightKit,
+    Request,
+    bursty_arrivals,
+    make_requests,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO / "tools") not in sys.path:
+    sys.path.insert(0, str(REPO / "tools"))
+
+F, D, N, WINDOW = 5, 30, 8, 6
+NAMES = ("fam0_f0_flx", "fam0_f1_eq", "fam1_f2_flx", "fam1_f3_long",
+         "fam2_f4_flx")
+LADDER = (1, 4, 8)
+SERVICE = 0.05
+
+
+@pytest.fixture(scope="module")
+def market():
+    rng = np.random.default_rng(20260804)
+    factors = rng.normal(size=(F, D, N))
+    factors[rng.uniform(size=factors.shape) < 0.05] = np.nan
+    return dict(
+        factors=factors,
+        returns=rng.normal(scale=0.02, size=(D, N)),
+        factor_ret=rng.normal(scale=0.01, size=(D, F)),
+        cap_flag=rng.integers(1, 4, size=(D, N)).astype(float),
+        investability=np.ones((D, N)),
+        universe=rng.uniform(size=(D, N)) > 0.05,
+    )
+
+
+def mk_server(market, **kw):
+    kw.setdefault("pad_ladder", LADDER)
+    return TenantServer(names=NAMES, **market, **kw)
+
+
+def equal_cfg(i=0, **kw):
+    kw.setdefault("method", "equal")
+    kw.setdefault("window", WINDOW)
+    kw.setdefault("icir_threshold", -1.0)
+    kw.setdefault("top_k", 1 + i % F)
+    return TenantConfig(**kw)
+
+
+def overload_kwargs(seed=1):
+    return dict(admission=AdmissionPolicy(max_depth=10),
+                service_model=lambda _t, _r: SERVICE,
+                fault_plan=DispatchFaultPlan(seed=seed, error_rate=0.25,
+                                             poison_rate=0.15),
+                retries=2)
+
+
+def overload_requests(n=24, *, tenants=True, seed=7):
+    cfgs = [equal_cfg(i, pct=0.1 + 0.02 * (i % 3)) for i in range(n)]
+    arrivals = bursty_arrivals(n, rate_hz=1.5 * LADDER[-1] / SERVICE,
+                               burst=5, seed=seed)
+    labels = [f"acct-{i % 6}" for i in range(n)] if tenants else None
+    return make_requests(cfgs, arrivals, deadline_s=0.6, tenants=labels)
+
+
+# ------------------------------------------------- recorder unit contract
+
+
+def test_recorder_span_tree_and_validation():
+    fr = reqtrace.FlightRecorder()
+    fr.begin("7", t=1.0, tenant="acct")
+    fr.event("7", "submit", t=1.0)
+    sid = fr.open("7", "queue/wait", t=1.2)
+    fr.close("7", sid, t=2.0)
+    d = fr.open("7", "dispatch", t=2.0, dispatch=0, members=["7"])
+    a = fr.open("7", "attempt", t=2.0, parent=d, attempt=0)
+    fr.close("7", a, t=2.5)
+    fr.close("7", d, t=2.5)
+    fr.finish("7", "SERVED", t=2.5)
+    rows = fr.rows("q")
+    assert fr.complete() and reqtrace.row_errors(rows) == []
+    assert rows[0]["tenant"] == "acct" and rows[0]["verdict"] == "SERVED"
+    # write-side guards: one begin, one finish, known parents only
+    with pytest.raises(ValueError, match="already begun"):
+        fr.begin("7", t=3.0)
+    with pytest.raises(ValueError, match="exactly one verdict"):
+        fr.finish("7", "SHED", t=3.0)
+    with pytest.raises(ValueError, match="parent"):
+        fr.open("7", "x", t=3.0, parent=99)
+    with pytest.raises(KeyError):
+        fr.open("8", "x", t=0.0)
+
+
+def test_row_errors_catch_unclosed_overlapping_and_orphans():
+    fr = reqtrace.FlightRecorder()
+    fr.begin("0", t=0.0)
+    fr.open("0", "never_closed", t=0.5)
+    fr.finish("0", "SERVED", t=1.0)
+    errs = reqtrace.row_errors(fr.rows("q"))
+    assert any("never closed" in e for e in errs)
+    assert not fr.complete()
+
+    # a child extending OUTSIDE its parent interval is an overlap
+    fr2 = reqtrace.FlightRecorder()
+    fr2.begin("0", t=0.0)
+    d = fr2.open("0", "dispatch", t=0.2)
+    a = fr2.open("0", "attempt", t=0.1, parent=d)  # starts before parent
+    fr2.close("0", a, t=0.3)
+    fr2.close("0", d, t=0.4)
+    fr2.finish("0", "SERVED", t=1.0)
+    assert any("overlaps outside" in e
+               for e in reqtrace.row_errors(fr2.rows("q")))
+
+    # a dispatch member with no trace row is an orphan trace id
+    fr3 = reqtrace.FlightRecorder()
+    fr3.begin("0", t=0.0)
+    d = fr3.open("0", "dispatch", t=0.1, members=["0", "ghost"])
+    fr3.close("0", d, t=0.2)
+    fr3.finish("0", "SERVED", t=0.5)
+    assert any("orphan trace id" in e
+               for e in reqtrace.row_errors(fr3.rows("q")))
+
+    # a serving row whose submissions exceed the trace count: a request
+    # with no flight record
+    rows = fr.rows("q") + [{"kind": "serving", "name": "q",
+                            "submitted": 3}]
+    assert any("no flight record" in e for e in reqtrace.row_errors(rows))
+
+
+def test_cost_meter_charges_split_merge_and_conserve():
+    m = metering.CostMeter()
+    m.charge(["a", "b"], 4, wall_s=1.0,
+             per_lane={"qp_solves": [3.0, 5.0, 2.0, 2.0]}, qp_solves=0.0)
+    m.overhead("overhead/retry", wall_s=0.25)
+    # uniform wall split: a and b pay 0.25 each, pad pays 0.5
+    assert m.accounts["a"]["wall_s"] == pytest.approx(0.25)
+    assert m.accounts[metering.OVERHEAD_PAD]["wall_s"] == pytest.approx(0.5)
+    # per-lane qp: real lanes their own counts, pads to overhead/pad
+    assert m.accounts["a"]["qp_solves"] == 3.0
+    assert m.accounts[metering.OVERHEAD_PAD]["qp_solves"] == 4.0
+    assert m.totals["qp_solves"] == 12.0
+    assert m.pad_fraction() == pytest.approx(0.5 / 1.25)
+    row = m.row("meter")
+    assert metering.conservation_errors(row) == []
+    # merge is exact and associative on these dict sums
+    m2 = metering.CostMeter()
+    m2.charge(["a"], 1, wall_s=2.0)
+    m.merge(m2)
+    assert m.accounts["a"]["wall_s"] == pytest.approx(2.25)
+    assert metering.conservation_errors(m.row("meter")) == []
+    # a doctored row fails conservation from the artifact alone
+    bad = m.row("meter")
+    bad["totals"]["wall_s"] += 1.0
+    assert any("dropped or double-billed" in e
+               for e in metering.conservation_errors(bad))
+    # guards
+    with pytest.raises(ValueError, match="unknown cost"):
+        m.charge(["a"], 1, joules=1.0)
+    with pytest.raises(ValueError, match="non-finite"):
+        m.charge(["a"], 1, wall_s=float("nan"))
+    with pytest.raises(ValueError, match="tenants"):
+        m.charge(["a", "b"], 1, wall_s=1.0)
+
+
+def test_health_series_ring_and_exact_maxima():
+    hs = reqtrace.HealthSeries(cap=3)
+    for i in range(6):
+        hs.sample(t=float(i), depth=10 - i, occupancy=0.5,
+                  shed_rate=0.1 * i, served_p99_s=None)
+    row = hs.row("h")
+    assert row["count"] == 6 and len(row["samples"]) == 3
+    assert row["max_depth"] == 10  # exact, though the sample left the ring
+    rt = reqtrace.HealthSeries()
+    rt.load_state(hs.state())
+    assert rt.row("h") == row
+
+
+def test_chrome_trace_export_shape():
+    fr = reqtrace.FlightRecorder()
+    fr.begin("0", t=0.0, tenant="acct")
+    d = fr.open("0", "dispatch", t=0.5, dispatch=0)
+    fr.close("0", d, t=1.0)
+    fr.finish("0", "SERVED", t=1.0)
+    doc = reqtrace.chrome_trace(fr.rows("q"))
+    events = doc["traceEvents"]
+    names = {e["name"] for e in events if e["ph"] == "M"}
+    assert "process_name" in names and "thread_name" in names
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"request", "dispatch"}
+    disp = next(e for e in xs if e["name"] == "dispatch")
+    assert disp["ts"] == 5e5 and disp["dur"] == 5e5  # virtual µs
+    root = next(e for e in xs if e["name"] == "request")
+    assert root["args"]["verdict"] == "SERVED"
+
+
+# --------------------------- the acceptance: overload + faults, traced
+
+
+def test_span_tree_completeness_under_bursty_overload_with_faults(market):
+    """The acceptance pin: the PR 10 overload-with-dispatch-faults trace,
+    flight recorder on — every submitted rid owns exactly one closed
+    span tree whatever its verdict, retries appear as attempt child
+    spans, and the metering conserves with the tenant-labeled accounts."""
+    server = mk_server(market)
+    res = server.serve_queued(overload_requests(), flight=True,
+                              **overload_kwargs())
+    kit = res.flight
+    c = res.counters
+    assert c["shed_count"] > 0 and c["dispatch_faults"] > 0  # real stress
+    assert isinstance(kit, FlightKit)
+    # one finished trace per submission, zero structural errors
+    assert len(kit.recorder.traces) == 24
+    assert kit.recorder.complete()
+    rows = kit.recorder.rows("serve/queue")
+    assert reqtrace.row_errors(rows) == []
+    assert sorted(int(r["trace_id"]) for r in rows) == list(range(24))
+    verdicts = {r["trace_id"]: r["verdict"] for r in rows}
+    for v in res.verdicts:
+        assert verdicts[str(v["rid"])] == v["verdict"]
+        assert v["tenant"] == f"acct-{v['rid'] % 6}"  # the satellite
+    # retries show up as attempt child spans under the shared dispatch
+    multi = [s for r in rows for s in r["spans"] if s["name"] == "dispatch"
+             and sum(1 for a in r["spans"]
+                     if a["name"] == "attempt"
+                     and a["parent"] == s["id"]) > 1]
+    assert multi, "no dispatch carried more than one attempt despite faults"
+    # every dispatch span links its chunk members, and the members exist
+    for r in rows:
+        for s in r["spans"]:
+            if s["name"] == "dispatch":
+                assert str(r["trace_id"]) in s["members"]
+    # metering: tenant accounts + explicit overheads conserve
+    mrow = kit.meter.row("serve/queue/metering")
+    assert metering.conservation_errors(mrow) == []
+    tenant_accounts = [a for a in mrow["accounts"]
+                       if not a.startswith("overhead/")]
+    assert set(tenant_accounts) <= {f"acct-{i}" for i in range(6)}
+    assert tenant_accounts, "no tenant was billed"
+    # faults burned real service time: the overhead accounts carry it
+    assert any(a in mrow["accounts"]
+               for a in ("overhead/retry", "overhead/failed"))
+    # health series sampled at every dispatch boundary with exact maxima
+    srow = kit.series.row("h")
+    assert srow["count"] == c["dispatches"]
+    assert srow["max_depth"] >= 1
+
+
+def test_flight_rows_land_in_reports_and_pass_strict(market):
+    server = mk_server(market)
+    rep = obs.RunReport("flight", latency=True)
+    with rep.activate():
+        server.serve_queued(overload_requests(seed=3), flight=True,
+                            **overload_kwargs(seed=2))
+    rows = rep.all_rows()
+    kinds = {r.get("kind") for r in rows}
+    assert {"reqtrace", "metering", "series", "serving"} <= kinds
+    # reqtrace rows share the serving row's name so the count-vs-
+    # submissions cross-check arms
+    assert all(r["name"] == "serve/queue" for r in rows
+               if r.get("kind") == "reqtrace")
+    import trace_report
+
+    assert trace_report.flight_errors(rows) == []
+    assert trace_report.malformed_rows(rows) == []
+    # the renderer carries the three new sections
+    text = trace_report.render(rows)
+    assert "request flight traces" in text
+    assert "cost metering" in text and "health series" in text
+
+
+def test_trace_report_strict_and_timeline_cli(market, tmp_path):
+    server = mk_server(market)
+    rep = obs.RunReport("flight-cli")
+    with rep.activate():
+        server.serve_queued(overload_requests(seed=5), flight=True,
+                            **overload_kwargs(seed=4))
+    good = tmp_path / "good.jsonl"
+    rep.write_jsonl(good)
+    timeline = tmp_path / "timeline.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(good), "--strict", "--timeline", str(timeline)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"timeline: {timeline}" in proc.stdout
+    doc = json.loads(timeline.read_text())
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+    # corrupt ONE span's close time -> unclosed tree -> strict exits 1
+    rows = [json.loads(line) for line in good.read_text().splitlines()]
+    for r in rows:
+        if r.get("kind") == "reqtrace":
+            r["spans"][1]["t1"] = None
+            break
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(bad), "--strict"], capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 1
+    assert "flight-recorder violation" in proc.stderr
+
+    # doctor a metering total -> conservation fails strict
+    rows = [json.loads(line) for line in good.read_text().splitlines()]
+    for r in rows:
+        if r.get("kind") == "metering":
+            r["totals"]["wall_s"] = r["totals"]["wall_s"] + 1.0
+            break
+    bad2 = tmp_path / "bad2.jsonl"
+    bad2.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(bad2), "--strict"], capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 1 and "metering" in proc.stderr
+
+    # --timeline on a report with no traces is unusable input (exit 2)
+    no_traces = tmp_path / "none.jsonl"
+    no_traces.write_text(json.dumps({"kind": "span", "name": "s",
+                                     "wall_s": 0.1, "fenced": True})
+                         + "\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(no_traces), "--timeline", str(tmp_path / "t2.json")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+
+
+# ------------------------------------------------- kill/resume differential
+
+
+def test_kill_resume_trace_log_byte_equal(market, tmp_path):
+    """The kit's state rides the existing queue snapshot seam: a run
+    stopped right after a mid-drain snapshot and resumed produces
+    reqtrace/metering/series rows BYTE-equal to an uninterrupted run."""
+    server = mk_server(market)
+    kw = overload_kwargs(seed=2)
+    straight = server.serve_queued(overload_requests(seed=11),
+                                   flight=True, **kw)
+    ck = tmp_path / "queue.ckpt"
+    partial = server.serve_queued(overload_requests(seed=11),
+                                  checkpoint_path=ck,
+                                  _stop_after_dispatches=1, flight=True,
+                                  **kw)
+    assert len(partial.verdicts) < 24 and ck.exists()
+    resumed = server.serve_queued(overload_requests(seed=11),
+                                  checkpoint_path=ck, flight=True, **kw)
+    assert resumed.log_lines() == straight.log_lines()
+
+    def flight_lines(res):
+        return [json.dumps(r, sort_keys=True)
+                for r in res.flight.rows("serve/queue")]
+
+    assert flight_lines(resumed) == flight_lines(straight)
+    assert resumed.flight.recorder.complete()
+
+
+# ------------------------------------------------- structural elision
+
+
+def test_queue_without_flight_elides_the_recorder_modules(market,
+                                                          tmp_path):
+    """PR 7-style unimportable pin: with obs.reqtrace and obs.metering
+    BLOCKED from importing, serve() AND the flightless queue still work
+    and produce bit-identical outputs — the recorder is pure opt-in
+    host-side bookkeeping the default paths never touch."""
+    cfg = equal_cfg(2, pct=0.2)
+    server = mk_server(market)
+    want = np.nan_to_num(
+        np.asarray(server.serve([cfg])[0].output.sim.weights))
+    market_path = tmp_path / "market.npz"
+    weights_path = tmp_path / "weights.npy"
+    np.savez(market_path, **{k: np.asarray(v) for k, v in market.items()})
+    script = f"""
+import sys
+sys.path.insert(0, {str(REPO)!r})
+class _Block:
+    BLOCKED = ("factormodeling_tpu.obs.reqtrace",
+               "factormodeling_tpu.obs.metering")
+    def find_spec(self, name, path=None, target=None):
+        if name in self.BLOCKED:
+            raise ImportError(f"{{name}} is blocked for the elision pin")
+        return None
+sys.meta_path.insert(0, _Block())
+import os
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from factormodeling_tpu.serve import TenantConfig, TenantServer
+market = np.load({str(market_path)!r}, allow_pickle=False)
+server = TenantServer(names={NAMES!r}, pad_ladder={LADDER!r},
+                      **{{k: market[k] for k in market.files}})
+cfg = TenantConfig(top_k=3, icir_threshold=-1.0, method="equal",
+                   window={WINDOW}, pct=0.2)
+out = server.serve([cfg])[0].output
+from factormodeling_tpu.serve.queue import Request, run_queued
+res = run_queued(server, [Request(0, cfg, 0.0, 5.0)],
+                 service_model=lambda _t, _r: 0.05)
+assert res.by_rid()[0]["verdict"] == "SERVED"
+assert res.flight is None
+assert "factormodeling_tpu.obs.reqtrace" not in sys.modules
+assert "factormodeling_tpu.obs.metering" not in sys.modules
+np.save({str(weights_path)!r},
+        np.nan_to_num(np.asarray(out.sim.weights)))
+print("ELISION_OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ELISION_OK" in proc.stdout
+    np.testing.assert_array_equal(np.load(weights_path), want)
+
+
+# ---------------------------------------- serving_stats split satellite
+
+
+def test_serving_stats_split_executions_vs_logical(market):
+    """Satellite: retried/poisoned attempts count EXECUTIONS only — the
+    two counters are explicit, and their difference is exactly the
+    faulted attempts."""
+    server = mk_server(market)
+    base = dict(server.serving_stats())
+    server.serve([equal_cfg(i) for i in range(3)])  # one chunk
+    stats = server.serving_stats()
+    assert (stats["dispatch_executions"] - base["dispatch_executions"]
+            == 1)
+    assert (stats["logical_dispatches"] - base["logical_dispatches"] == 1)
+
+    # a permanently-faulting dispatch: 3 executions (1 + 2 retries), ONE
+    # logical dispatch
+    base = dict(stats)
+    res = server.serve_queued(
+        [Request(0, equal_cfg(), 0.0, 10.0)],
+        service_model=lambda _t, _r: SERVICE,
+        fault_plan=DispatchFaultPlan(seed=0, poison_rate=1.0), retries=2)
+    assert res.by_rid()[0]["verdict"] == "FAILED"
+    stats = server.serving_stats()
+    assert (stats["dispatch_executions"] - base["dispatch_executions"]
+            == 3)
+    assert (stats["logical_dispatches"] - base["logical_dispatches"] == 1)
+    assert "dispatches" not in stats  # the ambiguous counter is gone
+
+
+# ----------------------------------------------- advance_all metering
+
+
+def test_advance_all_meters_per_bucket_date(market):
+    """Per-(bucket, date) metering for the online fan-out: each bucket
+    dispatch's fenced wall splits across the rung — real lanes into the
+    ``<bucket>@<date>`` account, pad lanes into ``overhead/pad`` — and
+    conserves."""
+    from factormodeling_tpu.online.state import DateSlice
+
+    server = mk_server(market, pad_ladder=(1, 4))
+    server.online_begin([equal_cfg(1), equal_cfg(2)])  # rung 4, 2 pads
+    meter = metering.CostMeter()
+    for t in range(3):
+        sl = DateSlice(
+            factors=np.asarray(market["factors"])[:, t, :],
+            returns=np.asarray(market["returns"])[t],
+            factor_ret=np.asarray(market["factor_ret"])[t],
+            cap_flag=np.asarray(market["cap_flag"])[t],
+            investability=np.asarray(market["investability"])[t],
+            universe=np.asarray(market["universe"])[t])
+        server.advance_all(sl, date=t, meter=meter)
+    row = meter.row("advance")
+    assert metering.conservation_errors(row) == []
+    accounts = row["accounts"]
+    dated = [a for a in accounts if "@" in a]
+    assert {a.rsplit("@", 1)[1] for a in dated} == {"0", "1", "2"}
+    assert all(a.startswith("online/bucket/") for a in dated)
+    # half the rung is padding: the pad account carries exactly half the
+    # metered wall
+    assert row["pad_fraction"] == pytest.approx(0.5, abs=1e-6)
+    assert meter.pad_lanes == 3 * 2
+
+
+# ------------------------------------------------- online engine traces
+
+
+def test_online_engine_tick_traces(market):
+    from factormodeling_tpu.online import DateSlice, OnlineEngine
+
+    eng = OnlineEngine(names=NAMES, n_assets=N,
+                       template=equal_cfg(2, pct=0.25, max_weight=0.4),
+                       horizon=4, dtype=np.float32, flight=True)
+    factors = np.asarray(market["factors"], np.float32)
+
+    def slice_at(t, fac=None):
+        fa = factors if fac is None else fac
+        return DateSlice(
+            factors=fa[:, t, :],
+            returns=np.asarray(market["returns"][t], np.float32),
+            factor_ret=np.asarray(market["factor_ret"][t], np.float32),
+            cap_flag=np.asarray(market["cap_flag"][t], np.float32),
+            investability=np.asarray(market["investability"][t],
+                                     np.float32))
+
+    for t in range(10):
+        eng.ingest(t, slice_at(t))
+    eng.ingest(9, slice_at(9))                       # duplicate
+    restated = factors.copy()
+    restated[:, 8, :] *= 1.25
+    eng.ingest(8, slice_at(8, restated), restate=True)
+    assert eng.verdict_complete()
+    rows = eng.flight_rows()
+    assert len(rows) == eng.counters["ingested_dates"] == 12
+    assert reqtrace.row_errors(rows) == []
+    assert [r["verdict"] for r in rows[-2:]] == ["rejected", "replayed"]
+    # the replay trace carries per-replayed-date advance events
+    replay = rows[-1]
+    replay_span = next(s for s in replay["spans"] if s["name"] == "replay")
+    dates = [s["date"] for s in replay["spans"]
+             if s["name"] == "advance" and s["parent"] == replay_span["id"]]
+    assert dates == [8, 9]
+    # name override keeps multiple engines per report distinguishable
+    assert eng.flight_rows("custom/name")[0]["name"] == "custom/name"
+    # default engines build no recorder at all
+    eng_off = OnlineEngine(names=NAMES, n_assets=N,
+                           template=equal_cfg(2), dtype=np.float32)
+    assert eng_off._flight is None and eng_off.flight_rows() == []
+
+
+# ------------------------------------------------- regression gates
+
+
+def _metering_report(wall_a=0.5, wall_b=0.5, pad=0.1, depth=4):
+    total = wall_a + wall_b + pad
+    return [
+        {"kind": "meta", "name": "report", "schema_version": 4,
+         "backend": "cpu", "device_kind": "cpu", "jax_version": "x",
+         "device_count": 1, "process_count": 1, "mesh_shape": None},
+        {"kind": "metering", "name": "q/metering",
+         "accounts": {"acct-a": {"wall_s": wall_a},
+                      "acct-b": {"wall_s": wall_b},
+                      "overhead/pad": {"wall_s": pad}},
+         "totals": {"wall_s": total}, "dispatches": 2, "lanes": 4,
+         "pad_lanes": 1, "pad_fraction": pad / total},
+        {"kind": "series", "name": "q/health", "count": 3, "cap": 512,
+         "max_depth": depth, "max_occupancy": 1.0,
+         "fields": ["t_s", "depth", "occupancy", "shed_rate",
+                    "served_p99_s"],
+         "samples": [[0.1, depth, 1.0, 0.0, None]]},
+    ]
+
+
+def test_diff_reports_metering_and_series_gates():
+    base = _metering_report()
+    # clean self-diff
+    assert diff_reports(base, _metering_report()).ok
+    # one tenant's bill doubled (beyond ratio + floor): regression, and
+    # armed under --no-wall (check_wall=False) — the charge is virtual
+    worse = _metering_report(wall_a=1.2)
+    res = diff_reports(base, worse, check_wall=False)
+    assert not res.ok
+    assert any(f.kind == "metering" and "acct-a" in f.name
+               for f in res.regressions)
+    # drift below the absolute floor never gates
+    assert diff_reports(base, _metering_report(wall_a=0.504),
+                        check_wall=False).ok
+    # pad-fraction growth beyond tolerance gates
+    res = diff_reports(base, _metering_report(pad=0.5), check_wall=False)
+    assert any("pad_fraction" in f.name for f in res.regressions)
+    # a vanished account is a schema regression
+    gone = _metering_report()
+    del gone[1]["accounts"]["acct-b"]
+    gone[1]["totals"]["wall_s"] -= 0.5
+    res = diff_reports(base, gone, check_wall=False)
+    assert any("bill vanished" in f.detail for f in res.regressions)
+    # max queue depth growth gates (beyond ratio + slack), armed no-wall
+    res = diff_reports(base, _metering_report(depth=9), check_wall=False)
+    assert any(f.kind == "series" and "max_depth" in f.name
+               for f in res.regressions)
+    assert diff_reports(base, _metering_report(depth=5),
+                        check_wall=False).ok  # within slack
+
+
+def test_report_diff_cli_gates_metering_under_no_wall(tmp_path):
+    base, new = tmp_path / "base.jsonl", tmp_path / "new.jsonl"
+    base.write_text("\n".join(json.dumps(r)
+                              for r in _metering_report()) + "\n")
+    new.write_text("\n".join(json.dumps(r)
+                             for r in _metering_report(wall_a=1.2))
+                   + "\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "report_diff.py"),
+         str(base), str(new), "--no-wall"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "metered cost" in proc.stdout

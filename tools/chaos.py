@@ -31,7 +31,13 @@ clock) instead of a single research step, asserting that every submitted
 request terminates in exactly one verdict (counts sum to submissions),
 that clean cells never FAIL a request, that bounded policies actually
 shed/degrade under overload while the open policy sheds nothing, and
-that served outputs still satisfy the production invariants above. With
+that served outputs still satisfy the production invariants above.
+Round 19: every cell additionally runs the request FLIGHT RECORDER and
+asserts its two invariants — every submitted request owns exactly one
+closed span tree (``trace_complete``; retries and FAILED dispatches
+included), and the per-tenant metering accounts plus the explicit
+pad/retry overheads sum back to the measured dispatch totals
+(``metering_conserved``). With
 ``--checkpoint`` the cell loop AND each cell's queue snapshot after
 every dispatch; the ``_FMT_SERVE_DIE_AFTER_DISPATCH`` env hook kills the
 process mid-drain and a rerun resumes byte-equal (the kill/resume
@@ -49,6 +55,11 @@ kill-after-apply stream resumes from its ``resil.checkpoint`` byte-equal
 (final state digest + content chain in the cell verdict; the
 ``_FMT_ONLINE_DIE_AFTER_DATE`` env hook SIGKILLs the real CLI mid-cell
 for the stdout-byte-equality differential in tests/test_online.py).
+Round 19: every cell additionally asserts flight-recorder tick-trace
+completeness (one closed span tree per ingestion the final engine saw —
+engine traces are per-process by contract) and per-(bucket, date)
+``advance_all`` metering conservation through a small metered two-tenant
+session (``trace_complete`` / ``metering_conserved`` in the verdict).
 
 ``--scenarios`` switches to the round-16 SCENARIO preset
 (``factormodeling_tpu.scenarios``, architecture.md §22): each cell runs a
@@ -467,10 +478,27 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                 admission=_serving_policy(serve_admission, pol_name, depth),
                 service_model=lambda _tag, _rung: service_s,
                 fault_plan=_serving_fault_plan(resil, fault, seed + idx),
-                retries=2, checkpoint_path=cell_ck)
+                retries=2, checkpoint_path=cell_ck,
+                queue_name=f"chaos/{cell}", flight=True)
 
             c = res.counters
             violations: list[str] = []
+            # round 19: every cell additionally proves the flight
+            # recorder's two invariants — one closed span tree per
+            # submitted request (faults included: a retried or FAILED
+            # dispatch still closes its spans) and metering conservation
+            # (per-tenant + overhead accounts sum to the dispatch totals)
+            from factormodeling_tpu.obs import metering as obs_metering
+
+            trace_complete = res.flight.recorder.complete()
+            if not trace_complete:
+                violations.append(
+                    "flight trace completeness: open or malformed span "
+                    f"tree(s) ({res.flight.recorder.open_traces()[:4]})")
+            conserve = obs_metering.conservation_errors(
+                res.flight.meter.row(cell))
+            if conserve:
+                violations.extend(conserve[:4])
             by_rid = res.by_rid()
             if sorted(by_rid) != list(range(n_requests)):
                 violations.append("verdict completeness: not every rid "
@@ -507,6 +535,8 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                     break
             result = {"fault": fault, "policy": pol_name,
                       "ok": not violations, "violations": violations,
+                      "trace_complete": bool(trace_complete),
+                      "metering_conserved": not conserve,
                       **{k: int(c[k]) for k in
                          ("submitted", "served", "shed_count",
                           "deadline_miss_count", "failed_count",
@@ -780,6 +810,44 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                                f"{np.abs(w).max():.3f} > 1 + {tol}")
         return bad[:8]
 
+    _advance_meter_cache: list = []
+
+    def metered_advance_errors() -> list:
+        """Round 19: the per-(bucket, date) metering conservation check
+        — a small two-tenant ``advance_all`` session with a CostMeter
+        attached; the per-bucket accounts plus the explicit pad account
+        must sum back to the measured dispatch walls. The check depends
+        only on the grid's shared fixtures, so it runs ONCE and every
+        cell asserts the cached verdict (review finding: per-cell
+        re-execution rebuilt the server and re-dispatched 3 dates per
+        cell for one bit of information)."""
+        if _advance_meter_cache:
+            return _advance_meter_cache[0]
+        from factormodeling_tpu.obs.metering import (CostMeter,
+                                                     conservation_errors)
+        from factormodeling_tpu.serve import TenantServer
+
+        srv = TenantServer(names=names, pad_ladder=(1, 4),
+                           factors=factors, returns=returns,
+                           factor_ret=factor_ret, cap_flag=cap_flag,
+                           investability=invest, universe=universe)
+        srv.online_begin([template, template])  # rung 4 -> 2 pad lanes
+        meter = CostMeter()
+        for t in range(3):
+            srv.advance_all(slice_at(t), date=t, meter=meter)
+        row = meter.row("chaos/online/advance_metering")
+        errs = list(conservation_errors(row))
+        if meter.pad_lanes != 3 * 2:
+            errs.append(f"advance metering: expected 6 pad lanes over 3 "
+                        f"dates, got {meter.pad_lanes}")
+        if row["pad_fraction"] is None or not (
+                0.0 < row["pad_fraction"] < 1.0):
+            errs.append(f"advance metering: pad fraction "
+                        f"{row['pad_fraction']!r} not in (0, 1) despite "
+                        f"padded lanes")
+        _advance_meter_cache.append(errs[:4])
+        return _advance_meter_cache[0]
+
     rep = report if report is not None else obs.RunReport("chaos-online")
     tmp_ctx = None
     if checkpoint_path is None:
@@ -816,9 +884,14 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                         has_universe=True, horizon=6,
                         guards=guards[pol_name], checkpoint=ck_file,
                         retain_history=True, dtype=np.float32,
-                        progress=lambda msg: progress(f"{cell}: {msg}"))
+                        progress=lambda msg: progress(f"{cell}: {msg}"),
+                        flight=True)
 
                 eng = make_engine()
+                # the recorder is per-process: the final engine's trace
+                # count must equal the ingestions IT saw (post-restart
+                # for kill cells), not the checkpoint-restored total
+                eng_birth_ingested = eng.counters["ingested_dates"]
                 verdicts = []
                 start = (eng.last_date + 1 if eng.last_date is not None
                          else 0)
@@ -828,6 +901,7 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                         # (both the clean and the killed CLI runs take
                         # it, so their streams stay identical)
                         eng = make_engine()
+                        eng_birth_ingested = eng.counters["ingested_dates"]
                     fac, uni = None, None
                     if anomaly == "nan_storm" and t == anomaly_at:
                         fac = factors.copy()
@@ -872,6 +946,26 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                             f"anomaly tick verdict ({got.status}, "
                             f"{got.reason}) != expected {expect}")
                 violations.extend(check_rows(verdicts))
+                # round 19: every tick the (final) engine ingested must
+                # own exactly one closed span tree (a kill cell's
+                # restarted engine judges its own post-restart ticks —
+                # engine traces are per-process by contract), and the
+                # per-(bucket, date) advance metering must conserve
+                from factormodeling_tpu.obs import reqtrace as obs_reqtrace
+
+                flight_rows = eng.flight_rows()
+                trace_errors = obs_reqtrace.row_errors(flight_rows)
+                expected_traces = (eng.counters["ingested_dates"]
+                                   - eng_birth_ingested)
+                trace_complete = (not trace_errors
+                                  and len(flight_rows) == expected_traces)
+                if not trace_complete:
+                    violations.append(
+                        f"flight trace completeness: {len(flight_rows)} "
+                        f"trace(s) for {expected_traces} ingestion(s), "
+                        f"errors {trace_errors[:2]}")
+                meter_errors = metered_advance_errors()
+                violations.extend(meter_errors)
                 # statuses derive from the engine's GLOBAL counters, not
                 # the verdicts this process saw: a killed-and-resumed
                 # cell's stdout must be byte-equal to a straight-through
@@ -882,6 +976,8 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                 result = {
                     "anomaly": anomaly, "policy": pol_name,
                     "ok": not violations, "violations": violations,
+                    "trace_complete": bool(trace_complete),
+                    "metering_conserved": not meter_errors,
                     "statuses": statuses,
                     "counters": {k: int(v)
                                  for k, v in sorted(eng.counters.items())},
@@ -897,6 +993,7 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                 }
                 rep.record(f"chaos/{cell}", kind="online",
                            **eng.report_fields())
+                rep.rows.extend(eng.flight_rows(f"chaos/{cell}/trace"))
                 progress(f"{cell}: "
                          f"{'ok' if result['ok'] else 'FAIL'} "
                          f"(statuses={statuses})")

@@ -32,7 +32,14 @@ grew against the same recorded feed or whose verdict counts no longer
 sum to its ingestions (``kind="online"`` rows, round 17 — armed under
 ``--no-wall``, and the ``online/*`` / ``bench/online_advance`` latency
 scopes keep their count-aware p50/p99 ratio gate armed there too: the
-advance p99 is the product's own SLO surface), or a seconds-valued
+advance p99 is the product's own SLO surface), a flight-recorder
+metering row whose per-tenant cost drifted beyond the ratio + absolute
+floor or whose pad-overhead fraction grew, or a health-series row whose
+max queue depth grew (``kind="metering"`` / ``kind="series"`` rows,
+round 19 — both armed under ``--no-wall``: the queue's metered wall and
+depth profile live on the VIRTUAL clock, deterministic for a recorded
+trace, so drift there is a scheduling/billing change, never machine
+speed), or a seconds-valued
 bench row beyond the ratio AND the baseline's recorded best-of-N spread
 — throughput rows with ANY ``/s`` unit (``configs/s``, ``paths/s``)
 gate on drops through the same clause —
@@ -121,6 +128,17 @@ def main(argv=None) -> int:
                              "scenario rows with tiny/negative baselines "
                              "(default 0.05; the ratio gate covers "
                              "well-sized risks)")
+    parser.add_argument("--metering-floor-s", type=float, default=0.005,
+                        help="absolute per-account metered-wall growth "
+                             "below this never gates (default 0.005 s; "
+                             "the metering gate stays armed under "
+                             "--no-wall — the charge is virtual)")
+    parser.add_argument("--pad-frac-tol", type=float, default=0.05,
+                        help="tolerated absolute growth of the metering "
+                             "rows' pad-overhead fraction (default 0.05)")
+    parser.add_argument("--depth-slack", type=int, default=2,
+                        help="absolute headroom on the health-series "
+                             "max-queue-depth gate (default 2)")
     parser.add_argument("--json", action="store_true",
                         help="emit the findings as one JSON object instead "
                              "of text")
@@ -153,7 +171,9 @@ def main(argv=None) -> int:
         check_wall=not args.no_wall, counter_tol=args.counter_tol,
         finite_tol=args.finite_tol, comms_ratio=args.comms_ratio,
         comms_min_bytes=args.comms_min_bytes, mem_ratio=args.mem_ratio,
-        mem_min_bytes=args.mem_min_bytes, risk_floor=args.risk_floor)
+        mem_min_bytes=args.mem_min_bytes, risk_floor=args.risk_floor,
+        metering_floor_s=args.metering_floor_s,
+        pad_frac_tol=args.pad_frac_tol, depth_slack=args.depth_slack)
 
     if args.json:
         print(json.dumps({
