@@ -229,7 +229,7 @@ def _serving_demo(report, say) -> None:
             max_depth=10,
             ladder=("serve_stale", "cheap_fallback", "reject_new")),
         service_model=lambda _tag, _rung: service_s,
-        queue_name="pipeline/serve/queue", flight=True)
+        queue_name="pipeline/serve/queue", flight=True, lineage=True)
     c = res.counters
     say(f"  loaded: {c['submitted']} requests at 1.5x capacity -> "
         f"{c['served']} served / {c['shed_count']} shed / "
@@ -241,6 +241,20 @@ def _serving_demo(report, say) -> None:
         f"(complete: {res.flight.recorder.complete()}), "
         f"{len(meter_row['accounts'])} metering accounts, pad fraction "
         f"{meter_row['pad_fraction']}")
+    # the round-20 provenance ledger rode the same drain (lineage=True):
+    # kind="lineage" derivation edges and kind="traffic" arrival rows are
+    # on the report now. Print ONE end-to-end explain transcript — the
+    # causal story of the last served book, from its published content
+    # fingerprint back to the panel/config source fingerprints, joined to
+    # its reqtrace dispatch span. Imported LAZILY: the unreported
+    # pipeline path never loads obs.lineage (the elision contract).
+    from factormodeling_tpu.obs import lineage as obs_lineage
+
+    say(f"  lineage: {len(res.lineage.edges)} provenance edges, "
+        f"{len(res.traffic)} traffic rows; explain of the last book:")
+    for line in obs_lineage.explain_lines(report.rows,
+                                          name="pipeline/serve/queue"):
+        say(f"    {line}")
 
 
 def _scenario_demo(report, say) -> None:

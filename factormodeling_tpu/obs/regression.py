@@ -141,10 +141,10 @@ from pathlib import Path
 
 __all__ = ["DiffResult", "Finding", "GATE_UP", "bench_rows", "comms_rows",
            "counter_scalars", "devtime_rows", "diff_reports",
-           "latency_rows", "load_jsonl", "memory_rows", "meta_row",
-           "metering_rows", "numerics_baseline", "online_rows",
+           "latency_rows", "lineage_rows", "load_jsonl", "memory_rows",
+           "meta_row", "metering_rows", "numerics_baseline", "online_rows",
            "scenario_rows", "series_rows", "serving_rows",
-           "sharding_rows", "span_totals"]
+           "sharding_rows", "span_totals", "traffic_rows"]
 
 #: absolute per-dimension growth floors of the metering gate — drift
 #: below the floor never gates, whatever the ratio says (a 2x ratio on
@@ -386,6 +386,28 @@ def bench_rows(rows) -> dict:
             if r.get("kind") == "bench"}
 
 
+def lineage_rows(rows) -> dict:
+    """name -> count of provenance edges (kind="lineage", the round-20
+    ledger). The diff gates on PRESENCE per ledger name — a producing
+    layer that stopped emitting its ledger is a schema break — not on
+    edge contents (content ids legitimately change with the data)."""
+    out: dict = defaultdict(int)
+    for r in rows:
+        if r.get("kind") == "lineage":
+            out[r.get("name", "")] += 1
+    return dict(out)
+
+
+def traffic_rows(rows) -> dict:
+    """name -> count of arrival-trace rows (kind="traffic", one per
+    request of every complete ``serve_queued`` drain)."""
+    out: dict = defaultdict(int)
+    for r in rows:
+        if r.get("kind") == "traffic":
+            out[r.get("name", "")] += 1
+    return dict(out)
+
+
 # ------------------------------------------------------------------ diff
 
 
@@ -430,6 +452,15 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
                 findings.append(Finding(
                     "schema", key, f"baseline {base_m.get(key)!r} vs new "
                                    f"{new_m.get(key)!r}"))
+        b_fp, n_fp = (base_m.get("code_fingerprint"),
+                      new_m.get("code_fingerprint"))
+        if b_fp != n_fp:
+            findings.append(Finding(
+                "schema", "code_fingerprint",
+                f"baseline code {b_fp!r} vs new {n_fp!r} — the reports "
+                f"come from DIFFERENT installed source trees; this is a "
+                f"cross-version comparison, read drift findings as "
+                f"code-change effects, not environment noise"))
     elif (base_m is None) != (new_m is None):
         findings.append(Finding(
             "schema", "meta",
@@ -979,6 +1010,33 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
         findings.append(Finding(
             "series", name, "health-series row absent from baseline "
             "(new recorder scope) — re-baseline to gate it"))
+
+    # ---- lineage/traffic rows (provenance ledger, round 20): PRESENCE
+    # per name is the schema contract — a producing layer that stopped
+    # emitting its ledger (or a drain that stopped recording arrivals)
+    # silently un-audits the run. Edge CONTENTS are content-addressed
+    # and legitimately change with the inputs, so counts/ids never gate;
+    # referential integrity is ``tools/lineage.py --strict``'s job.
+    base_ln, new_ln = lineage_rows(base_rows), lineage_rows(new_rows)
+    for name in sorted(set(base_ln) - set(new_ln)):
+        findings.append(Finding(
+            "lineage", name, "provenance ledger present in baseline, "
+            "missing in new report — the run lost its audit trail",
+            regression=True))
+    for name in sorted(set(new_ln) - set(base_ln)):
+        findings.append(Finding(
+            "lineage", name, "provenance ledger absent from baseline "
+            "(new lineage scope) — re-baseline to gate it"))
+    base_tr, new_tr = traffic_rows(base_rows), traffic_rows(new_rows)
+    for name in sorted(set(base_tr) - set(new_tr)):
+        findings.append(Finding(
+            "traffic", name, "arrival-trace rows present in baseline, "
+            "missing in new report — the drain stopped recording "
+            "traffic", regression=True))
+    for name in sorted(set(new_tr) - set(base_tr)):
+        findings.append(Finding(
+            "traffic", name, "arrival-trace rows absent from baseline "
+            "(new capture scope) — re-baseline to gate it"))
 
     # ---- bench rows: seconds-valued rows gate at wall_ratio against the
     # spread-aware baseline; presence never gates (configs are selected

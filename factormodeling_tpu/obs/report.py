@@ -22,7 +22,7 @@ from contextlib import contextmanager
 from pathlib import Path
 
 __all__ = ["RunReport", "SCHEMA_VERSION", "SpanHandle", "active_report",
-           "record_stage", "span", "cost_estimate"]
+           "code_fingerprint", "record_stage", "span", "cost_estimate"]
 
 #: report row-schema version, carried by every report's ``kind="meta"``
 #: header row. Bump when row kinds/fields change incompatibly;
@@ -38,6 +38,39 @@ _ACTIVE: "RunReport | None" = None
 def active_report() -> "RunReport | None":
     """The currently installed report (``RunReport.activate``), or None."""
     return _ACTIVE
+
+
+_CODE_FP: "str | None" = None
+
+
+def code_fingerprint() -> "str | None":
+    """Content hash of the installed ``factormodeling_tpu`` source tree
+    (``resil.checkpoint.fingerprint`` over every ``*.py`` file's bytes,
+    walked in sorted relative-path order with the path itself hashed
+    alongside the contents). Stamped into every report's meta header so
+    ``tools/report_diff.py`` can tell apart "same code, numbers moved"
+    from "different code entirely". None when the tree can't be read
+    (zipapp installs); computed once per process and cached — the source
+    tree doesn't change under a running interpreter."""
+    global _CODE_FP
+    if _CODE_FP is None:
+        try:
+            import numpy as np
+
+            import factormodeling_tpu
+            from factormodeling_tpu.resil.checkpoint import fingerprint
+
+            root = Path(factormodeling_tpu.__file__).resolve().parent
+            parts = []
+            for p in sorted(root.rglob("*.py")):
+                rel = p.relative_to(root).as_posix()
+                parts.append(np.frombuffer(
+                    rel.encode() + b"\x00" + p.read_bytes(),
+                    dtype=np.uint8))
+            _CODE_FP = fingerprint(*parts)
+        except Exception:
+            _CODE_FP = ""
+    return _CODE_FP or None
 
 
 def record_stage(name: str, **fields) -> None:
@@ -416,10 +449,13 @@ class RunReport:
         """The report's ``kind="meta"`` header row: row-schema version
         plus the environment identity (jax version, backend/device kind,
         device/process counts, mesh shape when a placement ledger noted
-        one). ``tools/report_diff.py`` refuses to gate reports whose
-        schema versions differ and downgrades wall gating to a warning
-        across backends — the meta row is what makes either judgment
-        possible from the artifact alone."""
+        one, and a ``code_fingerprint`` content hash of the installed
+        ``factormodeling_tpu`` source tree). ``tools/report_diff.py``
+        refuses to gate reports whose schema versions differ, downgrades
+        wall gating to a warning across backends, and notes
+        cross-version comparisons when code fingerprints differ — the
+        meta row is what makes any of these judgments possible from the
+        artifact alone."""
         import jax
 
         dev = jax.devices()[0]
@@ -430,7 +466,8 @@ class RunReport:
                 "device_kind": dev.device_kind,
                 "device_count": jax.device_count(),
                 "process_count": jax.process_count(),
-                "mesh_shape": self.meta.get("mesh_shape")}
+                "mesh_shape": self.meta.get("mesh_shape"),
+                "code_fingerprint": code_fingerprint()}
 
     def all_rows(self) -> list:
         """Header + recorded rows + the latency rollup rows — what

@@ -164,6 +164,20 @@ class OnlineEngine:
         NOT ride the checkpoint (a resumed engine's recorder starts at
         the resume point) — the byte-equal kill/resume trace contract is
         the serving queue's, whose snapshot seam the queue kit rides.
+      lineage: the round-20 provenance ledger — ``True`` builds a
+        :class:`~factormodeling_tpu.obs.lineage.LineageLedger` (or pass
+        one to share); every APPLIED/REPLAYED date then records one
+        content-addressed derivation edge chaining the pre-apply state
+        fingerprint and the date slice's fingerprint to the post-apply
+        state fingerprint, with the engine version, audit-chain head and
+        replay counter in ``state={}``; a replay's edge carries
+        ``supersedes=<the superseded application's output id>``.
+        ``lineage_rows()`` renders them. Unlike the flight recorder the
+        ledger DOES ride the checkpoint (one sorted-keys JSON string),
+        so a resumed engine's ledger is byte-equal to straight-through —
+        ``tools/lineage.py explain`` walks the chain across the kill.
+        OFF by default; ``obs.lineage`` is never imported when off (the
+        elision contract).
     """
 
     def __init__(self, *, names, n_assets: int, template=None,
@@ -172,7 +186,7 @@ class OnlineEngine:
                  checkpoint_every: int = 1, retain_history: bool = True,
                  checkpoint_history: bool = True,
                  stats_tail: int = 8, dtype=None, progress=None,
-                 flight=None):
+                 flight=None, lineage=None):
         import jax.numpy as jnp
 
         from factormodeling_tpu.composite import prefix_group_ids
@@ -235,6 +249,12 @@ class OnlineEngine:
 
             self._flight = (flight if isinstance(flight, FlightRecorder)
                             else FlightRecorder())
+        self._lineage = None
+        if lineage:
+            from factormodeling_tpu.obs.lineage import LineageLedger
+
+            self._lineage = (lineage if isinstance(lineage, LineageLedger)
+                             else LineageLedger())
 
         self._ck = None
         if checkpoint is not None:
@@ -245,6 +265,17 @@ class OnlineEngine:
                         else resil.Checkpointer(checkpoint,
                                                 every=checkpoint_every))
             self._maybe_resume()
+        if self._lineage is not None:
+            from factormodeling_tpu.resil.checkpoint import fingerprint
+
+            # genesis anchor: the chain's first prev-state must resolve.
+            # After a RESUME the current state's fingerprint is the last
+            # applied edge's output id, already in the restored ledger —
+            # registering nothing keeps the resumed ledger byte-equal to
+            # straight-through. (source() is idempotent regardless.)
+            fp = fingerprint(*self._leaves(self._state))
+            if not self._lineage.known(fp):
+                self._lineage.source(fp, "state_genesis")
 
     # ------------------------------------------------------------ state io
 
@@ -258,9 +289,13 @@ class OnlineEngine:
             self._treedef, [jnp.asarray(x) for x in leaves])
 
     def _ck_meta(self) -> dict:
+        # lineage key only when on (like the queue kit's flag): snapshots
+        # from before the feature — or from lineage-off runs — stay
+        # resumable by lineage-off engines
         return {"entry": "online_engine", "config": self._config_tag,
                 "horizon": self.horizon,
-                "retain_history": self.retain_history}
+                "retain_history": self.retain_history,
+                **({"lineage": True} if self._lineage is not None else {})}
 
     def _save(self, *, force: bool = False):
         if self._ck is None:
@@ -278,6 +313,8 @@ class OnlineEngine:
                         if self.retain_history and self.checkpoint_history
                         else []),
         }
+        if self._lineage is not None:
+            state["lineage"] = self._lineage.state()
         if force:
             self._ck.save(state, meta=self._ck_meta())
         else:
@@ -306,6 +343,8 @@ class OnlineEngine:
         self._history = [(int(d), h) for d, h in state["history"]]
         self._history_complete = (
             {d for d, _ in self._history} == set(self._applied))
+        if self._lineage is not None and "lineage" in state:
+            self._lineage.load_state(str(state["lineage"]))
         self._progress(f"online: resumed at date {self.last_date} "
                        f"({self.counters['applied_dates']} applied) "
                        f"from {self._ck.path}")
@@ -389,6 +428,30 @@ class OnlineEngine:
         for key in sorted(h):
             ch.update(np.ascontiguousarray(h[key]).tobytes())
         self._chain = ch.hexdigest()
+        if self._lineage is not None:
+            from factormodeling_tpu.resil.checkpoint import fingerprint
+
+            led = self._lineage
+            # prev-state id = the ring snapshot's fingerprint, which IS
+            # the previous application's output id (or the genesis
+            # source) — a rollback restores an older snapshot and the
+            # chain re-forks from there without bookkeeping
+            prev_id = fingerprint(*pre[1])
+            slice_id = led.source(
+                fingerprint(*[np.ascontiguousarray(h[k])
+                              for k in sorted(h)]),
+                "date_slice", date=int(date))
+            sup = led.last_edge(date=int(date)) if replaying else None
+            led.edge(fingerprint(*self._leaves(self._state)),
+                     "replayed" if replaying else "applied",
+                     [prev_id, slice_id],
+                     state={"version": self.version,
+                            "chain": self._chain[:16],
+                            "replays":
+                                self.counters["replay_applied_dates"]},
+                     date=int(date),
+                     **({"supersedes": sup["output_id"]}
+                        if sup is not None else {}))
         host = _out_to_host(out)
         return [host] if bool(host["ready"]) else []
 
@@ -439,6 +502,16 @@ class OnlineEngine:
             return []
         return self._flight.rows(name if name is not None
                                  else f"online/engine/{self._config_tag}")
+
+    def lineage_rows(self, name: str | None = None) -> list:
+        """The provenance ledger's ``kind="lineage"`` rows (empty with
+        lineage off) — append them to a report next to the
+        ``kind="online"`` rows; ``tools/lineage.py explain --date D``
+        then walks any applied date's state chain back to genesis."""
+        if self._lineage is None:
+            return []
+        return self._lineage.rows(name if name is not None
+                                  else f"online/engine/{self._config_tag}")
 
     def _ingest_inner(self, date: int, date_slice: DateSlice,
                       restate: bool = False) -> OnlineVerdict:

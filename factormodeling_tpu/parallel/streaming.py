@@ -260,7 +260,7 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
                           prefetch: int = 0,
                           mesh: Mesh | None = None,
                           date_axis: str = "date",
-                          checkpoint=None) -> dict:
+                          checkpoint=None, lineage=None) -> dict:
     """Pass 1: per-(factor, date) stats for a streamed stack.
 
     Returns the :func:`daily_factor_stats` dict with every array
@@ -289,6 +289,14 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
     Each save fences on its chunk's results (host transfer), so
     checkpointing trades throughput for resumability; thin with
     ``Checkpointer(every=k)``.
+
+    ``lineage`` (round 20): ``True`` or a shared
+    :class:`~factormodeling_tpu.obs.lineage.LineageLedger` records one
+    ``stream_chunk`` provenance edge per chunk (the chunk's stats
+    fingerprint, derived from the returns/universe input fingerprint);
+    the ledger rides the checkpoint so a resumed run's ledger is
+    byte-equal to straight-through, and rows land on the active report
+    at completion. OFF by default; ``obs.lineage`` never imports off.
     """
     if n_chunks <= 0:
         raise ValueError(f"n_chunks must be positive, got {n_chunks}")
@@ -298,6 +306,13 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
     one = _stats_kernel(source if fuse_source else None, shift_periods,
                         tuple(stats))
 
+    ledger = inputs_id = _lfp = None
+    if lineage:
+        from factormodeling_tpu.obs.lineage import LineageLedger
+        from factormodeling_tpu.resil.checkpoint import fingerprint as _lfp
+
+        ledger = (lineage if isinstance(lineage, LineageLedger)
+                  else LineageLedger())
     start, parts = 0, []
     ck_meta = None
     if checkpoint is not None:
@@ -328,8 +343,14 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
             state, _ = got
             start = int(state["next_chunk"])
             parts = list(state["parts"])
+            if ledger is not None and "lineage" in state:
+                ledger.load_state(str(state["lineage"]))
             record_stage("streaming/resume", entry="streamed_factor_stats",
                          resumed_chunks=start)
+    if ledger is not None:
+        # idempotent + after any resume (the restored ledger already
+        # holds this source — no duplicate, resumed stays byte-equal)
+        inputs_id = ledger.source(_lfp(returns, universe), "stream_inputs")
 
     def _keep(part):
         # checkpointing fetches each part to host ONCE, as it lands — a
@@ -340,22 +361,39 @@ def streamed_factor_stats(source: Callable[[int], jnp.ndarray],
             part = {k: np.asarray(v) for k, v in part.items()}
         parts.append(part)
 
+    def _lin(i):
+        # edge BEFORE the save so the snapshot carries its own chunk
+        if ledger is not None:
+            p = parts[-1]
+            ledger.edge(_lfp(*[p[k] for k in sorted(p)]), "stream_chunk",
+                        [inputs_id], chunk=int(i))
+
     def _save(i):
         if checkpoint is not None:
-            checkpoint.maybe_save(i, {"next_chunk": i + 1, "parts": parts},
-                                  meta=ck_meta)
+            state = {"next_chunk": i + 1, "parts": parts}
+            if ledger is not None:
+                state["lineage"] = ledger.state()
+            checkpoint.maybe_save(i, state, meta=ck_meta)
 
     if fuse_source:
         for i in range(start, n_chunks):
             _keep(one(i, returns, universe))
+            _lin(i)
             _save(i)
     else:
         for i, chunk in enumerate(_prefetched(source, n_chunks, prefetch,
                                               start=start), start=start):
             _keep(one(chunk_put(chunk), returns, universe))
+            _lin(i)
             _save(i)
     record_stage("streaming/stats", chunks=n_chunks, fused=fuse_source,
                  prefetch=prefetch, cache=streaming_cache_stats())
+    if ledger is not None:
+        from factormodeling_tpu.obs.report import active_report
+
+        rep = active_report()
+        if rep is not None:
+            rep.rows.extend(ledger.rows("streaming/stats"))
     return {k: jnp.concatenate([jnp.asarray(p[k]) for p in parts], axis=0)
             for k in parts[0]}
 

@@ -104,7 +104,8 @@ def checkpointed_manager_sweep(factors: jnp.ndarray,
                                settings: SimulationSettings, *,
                                combo_batch: int = 8,
                                chunk_combos: int | None = None,
-                               checkpoint=None) -> SweepOutput:
+                               checkpoint=None,
+                               lineage=None) -> SweepOutput:
     """:func:`manager_sweep` as a host-chunked loop with atomic
     snapshot/resume — the long-running form of the 1000-combo sweep
     (BASELINE.json config 5), built for interruption.
@@ -122,6 +123,15 @@ def checkpointed_manager_sweep(factors: jnp.ndarray,
     (differential-tested in ``tests/test_resil.py``). A snapshot recorded
     under a different (combo count, chunking, shape) config is skipped
     with a warning.
+
+    ``lineage`` (round 20): ``True`` or a shared
+    :class:`~factormodeling_tpu.obs.lineage.LineageLedger` records one
+    ``sweep_chunk`` provenance edge per chunk (the chunk's output
+    fingerprint, derived from the combo/factor/settings input
+    fingerprint); the ledger rides the checkpoint so a resumed sweep's
+    ledger is byte-equal to straight-through, and rows land on the
+    active report at completion. OFF by default; ``obs.lineage`` never
+    imports when off.
     """
     c = int(combo_weights.shape[0])
     if chunk_combos is None:
@@ -131,6 +141,13 @@ def checkpointed_manager_sweep(factors: jnp.ndarray,
     with obs_stage("sweep/books"):
         books, _, _ = compute_manager_weights(factors, settings)
 
+    ledger = inputs_id = _lfp = None
+    if lineage:
+        from factormodeling_tpu.obs.lineage import LineageLedger
+        from factormodeling_tpu.resil.checkpoint import fingerprint as _lfp
+
+        ledger = (lineage if isinstance(lineage, LineageLedger)
+                  else LineageLedger())
     start, parts = 0, []
     ck_meta = None
     if checkpoint is not None:
@@ -152,7 +169,15 @@ def checkpointed_manager_sweep(factors: jnp.ndarray,
             state, _ = got
             start = int(state["next_chunk"])
             parts = [SweepOutput(**p) for p in state["parts"]]
+            if ledger is not None and "lineage" in state:
+                ledger.load_state(str(state["lineage"]))
             record_stage("parallel/sweep_resume", resumed_chunks=start)
+    if ledger is not None:
+        # idempotent + after any resume (the restored ledger already
+        # holds this source — no duplicate, resumed stays byte-equal)
+        inputs_id = ledger.source(
+            _lfp(*jax.tree_util.tree_leaves(
+                (combo_weights, factors, settings))), "sweep_inputs")
 
     bounds = [(i, min(i + chunk_combos, c))
               for i in range(0, c, chunk_combos)]
@@ -167,14 +192,27 @@ def checkpointed_manager_sweep(factors: jnp.ndarray,
             out = SweepOutput(**{k: np.asarray(v)
                                  for k, v in out._asdict().items()})
         parts.append(out)
+        if ledger is not None:
+            d = out._asdict()
+            ledger.edge(_lfp(*[d[k] for k in sorted(d)]), "sweep_chunk",
+                        [inputs_id], chunk=int(idx),
+                        combos=[int(lo), int(hi)])
         if checkpoint is not None:
             checkpoint.maybe_save(
                 idx, {"next_chunk": idx + 1,
-                      "parts": [p._asdict() for p in parts]},
+                      "parts": [p._asdict() for p in parts],
+                      **({"lineage": ledger.state()}
+                         if ledger is not None else {})},
                 meta=ck_meta)
     record_stage("parallel/sweep", combos=c, factors=int(factors.shape[0]),
                  combo_batch=combo_batch, chunked=chunk_combos,
                  resumed_chunks=start)
+    if ledger is not None:
+        from factormodeling_tpu.obs.report import active_report
+
+        rep = active_report()
+        if rep is not None:
+            rep.rows.extend(ledger.rows("parallel/sweep"))
     return SweepOutput(*[jnp.concatenate(
         [jnp.asarray(getattr(p, f)) for p in parts], axis=0)
         for f in SweepOutput._fields])

@@ -327,7 +327,8 @@ def run_scenarios(*, names, template, spec, policy=None, factors, returns,
                   levels=DEFAULT_LEVELS, return_books: bool = False,
                   map_chunk=None, checkpoint_path=None,
                   checkpoint_every: int = 1, report=None, tag=None,
-                  runner=None, progress=None) -> ScenarioResult:
+                  runner=None, progress=None,
+                  lineage=None) -> ScenarioResult:
     """Run ``n_paths`` scenario paths of one family through the tenant
     step, chunked, and fold the per-path risk scalars into mergeable
     sketches (module docs). Returns a :class:`ScenarioResult`; with
@@ -341,6 +342,15 @@ def run_scenarios(*, names, template, spec, policy=None, factors, returns,
     straight-through run — the sketches merge exactly, so resume cannot
     change the answer. Incompatible with ``return_books`` (books are not
     snapshotted; a resumed sweep could not reconstruct the killed run's).
+
+    ``lineage`` (round 20): ``True`` or a shared
+    :class:`~factormodeling_tpu.obs.lineage.LineageLedger` records one
+    content-addressed ``scenario_chunk`` edge per chunk — the chunk's
+    host risk metrics fingerprint, derived from the path spec's
+    fingerprint and the base-market panels' fingerprint. The ledger
+    rides the checkpoint, so a resumed sweep's ledger is byte-equal to
+    straight-through; rows land on ``report`` when the sweep completes.
+    OFF by default; ``obs.lineage`` never imports when off.
     """
     import numpy as np
 
@@ -390,6 +400,12 @@ def run_scenarios(*, names, template, spec, policy=None, factors, returns,
     degrade: dict[str, int] = {}
     n_chunks = -(-n_paths // chunk)
     start_chunk = 0
+    ledger = None
+    if lineage:
+        from factormodeling_tpu.obs.lineage import LineageLedger
+
+        ledger = (lineage if isinstance(lineage, LineageLedger)
+                  else LineageLedger())
     ck = None
     if checkpoint_path is not None:
         ck_meta = {
@@ -414,9 +430,22 @@ def run_scenarios(*, names, template, spec, policy=None, factors, returns,
             nonfinite = {k: int(v) for k, v in state["nonfinite"].items()}
             nonfinite_path_count = int(state["nonfinite_path_count"])
             degrade = {k: int(v) for k, v in state["degrade"].items()}
+            if ledger is not None and "lineage" in state:
+                ledger.load_state(str(state["lineage"]))
             if progress:
                 progress(f"scenarios: resumed {start_chunk}/{n_chunks} "
                          f"chunks from {checkpoint_path}")
+    spec_id = market_id = None
+    if ledger is not None:
+        # idempotent + AFTER any resume: the restored ledger already
+        # carries these sources, so re-registering is a no-op and the
+        # resumed ledger stays byte-equal to straight-through
+        spec_id = ledger.source(
+            resil.fingerprint(*jax.tree_util.tree_leaves(spec)),
+            "path_spec", family=family)
+        market_id = ledger.source(
+            resil.fingerprint(*(p for p in panels if p is not None)),
+            "base_market")
 
     stop_after = os.environ.get(_STOP_ENV)
     books_chunks = []
@@ -447,6 +476,12 @@ def run_scenarios(*, names, template, spec, policy=None, factors, returns,
         if policy is not None:
             for k, v in tallies.items():
                 degrade[k] = degrade.get(k, 0) + int(np.asarray(v).sum())
+        if ledger is not None:
+            ledger.edge(
+                resil.fingerprint(*[host[k] for k in sorted(host)]),
+                "scenario_chunk", [spec_id, market_id],
+                code={"static_key": repr(tenant.static_key())},
+                chunk=int(ci), paths=[int(lo), int(hi)])
         if return_books:
             books_chunks.append(outs)
         if progress:
@@ -456,7 +491,10 @@ def run_scenarios(*, names, template, spec, policy=None, factors, returns,
             ck.maybe_save(ci, {"next_chunk": ci + 1, "acc": acc.state(),
                                "nonfinite": dict(nonfinite),
                                "nonfinite_path_count": nonfinite_path_count,
-                               "degrade": dict(degrade)}, meta=ck_meta)
+                               "degrade": dict(degrade),
+                               **({"lineage": ledger.state()}
+                                  if ledger is not None else {})},
+                          meta=ck_meta)
             if stop_after is not None \
                     and ci - start_chunk + 1 >= int(stop_after):
                 # the kill seam: checkpoint written, NO rows emitted —
@@ -482,6 +520,8 @@ def run_scenarios(*, names, template, spec, policy=None, factors, returns,
             fields = {k: v for k, v in row.items()
                       if k not in ("kind", "name")}
             report.record(row["name"], kind="scenario", **fields)
+        if ledger is not None:
+            report.rows.extend(ledger.rows(tag))
     return ScenarioResult(family=family, n_paths=n_paths, rows=rows,
                           accumulator=acc, nonfinite=dict(nonfinite),
                           nonfinite_path_count=nonfinite_path_count,

@@ -403,12 +403,46 @@ class TenantServer:
         error-faulted attempts never reached the executable at all)."""
         self._stats["logical_dispatches"] += 1
 
-    def serve(self, configs) -> list[TenantResult]:
+    def panels_fingerprint(self) -> str:
+        """Content address of this server's market panels
+        (``resil.checkpoint.fingerprint`` over all six panel slots, None
+        slots hashed as absent) — the ``panels`` source id every lineage
+        dispatch edge points back to. Computed once and cached: the
+        panels are fixed for the server's lifetime."""
+        fp = getattr(self, "_panels_fp", None)
+        if fp is None:
+            from factormodeling_tpu.resil.checkpoint import fingerprint
+
+            fp = self._panels_fp = fingerprint(
+                *[None if p is None else np.asarray(p)
+                  for p in self._panels])
+        return fp
+
+    def serve(self, configs, *, lineage=None) -> list[TenantResult]:
         """Validate, bucket, pad, dispatch, demux (module docs). Returns
-        one :class:`TenantResult` per submitted config, in order."""
+        one :class:`TenantResult` per submitted config, in order.
+
+        ``lineage`` (round 20): ``True`` or an existing
+        :class:`~factormodeling_tpu.obs.lineage.LineageLedger` records one
+        content-addressed provenance edge per served lane —
+        book-fingerprint <- {panels, config} with the executable identity
+        (no reqtrace join on this synchronous path: dispatch ids belong
+        to the queue). Rows land on the active report under
+        ``serve/sync``; pass your own ledger to inspect it afterwards.
+        OFF by default — ``lineage=None`` never imports ``obs.lineage``
+        (the elision contract)."""
         configs = list(configs)
         if not configs:
             return []
+        ledger = panels_id = _fp = None
+        if lineage:
+            from factormodeling_tpu.obs.lineage import LineageLedger
+            from factormodeling_tpu.resil.checkpoint import fingerprint \
+                as _fp
+
+            ledger = (lineage if isinstance(lineage, LineageLedger)
+                      else LineageLedger())
+            panels_id = ledger.source(self.panels_fingerprint(), "panels")
         normalized = []
         for i, c in enumerate(configs):
             try:
@@ -437,10 +471,37 @@ class TenantServer:
                              configs=len(chunk), padded_lanes=pad,
                              bucket_count=len(self._buckets_seen))
                 for lane, i in enumerate(chunk):
+                    out_i = jax.tree_util.tree_map(
+                        lambda a, lane=lane: a[lane], out)
                     results[i] = TenantResult(
-                        index=i, config=configs[i],
-                        output=jax.tree_util.tree_map(
-                            lambda a, lane=lane: a[lane], out))
+                        index=i, config=configs[i], output=out_i)
+                    if ledger is not None:
+                        cfg_id = ledger.source(
+                            _fp(*[np.asarray(l) for l in
+                                  jax.tree_util.tree_leaves(normalized[i])]),
+                            "config")
+                        # the BOOK (daily weight panel) is the published
+                        # artifact — hashing it alone, not all ~33 output
+                        # leaves, keeps provenance inside the 2% bound
+                        book = getattr(getattr(out_i, "sim", None),
+                                       "weights", None)
+                        ledger.edge(
+                            _fp(*([np.asarray(book)] if book is not None
+                                  else [np.asarray(l) for l in
+                                        jax.tree_util.tree_leaves(out_i)])),
+                            "dispatch", [panels_id, cfg_id],
+                            code={"static_key": repr(skey), "bucket": name,
+                                  "rung": int(rung),
+                                  "mesh": (dict(self.mesh.shape)
+                                           if self.mesh is not None
+                                           else None)},
+                            rid=int(i))
+        if ledger is not None:
+            from factormodeling_tpu.obs.report import active_report
+
+            rep = active_report()
+            if rep is not None:
+                rep.rows.extend(ledger.rows("serve/sync"))
         return results
 
     def serve_queued(self, requests, **kwargs):

@@ -87,7 +87,7 @@ from factormodeling_tpu.serve.tenant import TenantConfig, stack_configs
 __all__ = ["DEADLINE_MISS", "FAILED", "SERVED", "SHED", "VERDICTS",
            "DispatchEstimator", "FlightKit", "QueueResult", "Request",
            "VirtualClock", "bursty_arrivals", "make_requests",
-           "poisson_arrivals", "run_queued"]
+           "poisson_arrivals", "replay_traffic", "run_queued"]
 
 #: the verdict state machine's four terminal states — every submitted
 #: request ends in exactly one (the loop asserts the counts sum)
@@ -302,6 +302,9 @@ class QueueResult(NamedTuple):
     counters: dict      # the kind="serving" row's counts
     clock_s: float      # virtual makespan (last event time)
     flight: object = None  # the FlightKit when the recorder ran, else None
+    traffic: list = None   # kind="traffic" arrival-trace rows (complete
+    #                        drains only — the replay_traffic input)
+    lineage: object = None  # the LineageLedger when provenance ran
 
     def by_rid(self) -> dict:
         return {v["rid"]: v for v in self.verdicts}
@@ -418,7 +421,8 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                retry_backoff_s: float = 0.001, flush_headroom_s: float = 0.0,
                clock=None, seed_latency=None, checkpoint_path=None,
                checkpoint_every: int = 1, queue_name: str = "serve/queue",
-               flight=None, _stop_after_dispatches=None) -> QueueResult:
+               flight=None, lineage=None,
+               _stop_after_dispatches=None) -> QueueResult:
     """Drain ``requests`` through ``server`` under the traffic layer
     (module docs). Prefer calling it as
     :meth:`~factormodeling_tpu.serve.frontend.TenantServer.serve_queued`.
@@ -446,6 +450,21 @@ def run_queued(server, requests, *, admission=None, service_model=None,
     kit's state rides the checkpoint so a resumed run's trace log is
     byte-equal to straight-through. The kit returns on
     ``QueueResult.flight``.
+    ``lineage``: the round-20 provenance ledger — ``True`` builds a fresh
+    :class:`~factormodeling_tpu.obs.lineage.LineageLedger` (an existing
+    ledger is accepted to accumulate edges across runs); every dispatched
+    lane then records one content-addressed ``kind="lineage"`` edge
+    output-book-fingerprint <- {panels, config} with the executable
+    identity and the reqtrace dispatch id. Same elision contract as
+    ``flight``: OFF by default, ``lineage=None`` never imports
+    ``obs.lineage`` (subprocess-pinned), ledger state rides the
+    checkpoint so a resumed ledger is byte-equal to straight-through,
+    and the ledger returns on ``QueueResult.lineage``.
+    Every COMPLETE drain additionally records ``kind="traffic"``
+    arrival-trace rows (rid, tenant, exact arrival/deadline seconds,
+    static key, final verdict) — unconditionally, they are plain host
+    data — on ``QueueResult.traffic`` and the active report; feed them
+    to :func:`replay_traffic` to re-submit the recorded trace.
     ``_stop_after_dispatches``: test seam — return the PARTIAL result
     right after that many dispatches have snapshotted (the in-process
     half of the kill/resume differential; the out-of-process half is the
@@ -465,6 +484,14 @@ def run_queued(server, requests, *, admission=None, service_model=None,
     kit = None
     if flight:
         kit = flight if isinstance(flight, FlightKit) else FlightKit()
+    # the provenance ledger follows the identical opt-in shape: lineage=None
+    # (the default) never imports obs.lineage — the same elision pin
+    ledger = None
+    if lineage:
+        from factormodeling_tpu.obs.lineage import LineageLedger
+
+        ledger = (lineage if isinstance(lineage, LineageLedger)
+                  else LineageLedger())
     ladder = server.pad_ladder
     top = ladder[-1]
     n = len(requests)
@@ -514,7 +541,8 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                    "retry_backoff_s": float(retry_backoff_s),
                    "flush_headroom_s": float(flush_headroom_s),
                    "fault_plan": repr(fault_plan),
-                   **({"flight": True} if kit is not None else {})}
+                   **({"flight": True} if kit is not None else {}),
+                   **({"lineage": True} if ledger is not None else {})}
         # recorder ON joins the guard (resuming a flight-on snapshot
         # without the kit — or vice versa — would silently drop the
         # trace log's prefix), but flight-OFF runs deliberately omit
@@ -541,6 +569,8 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             stale.load_state(state["stale"])
             if kit is not None and "flight" in state:
                 kit.load_state(str(state["flight"]))
+            if ledger is not None and "lineage" in state:
+                ledger.load_state(str(state["lineage"]))
             for skey, items in state["pending"]:
                 # bucket keys restore in snapshot order, EMPTY buckets
                 # included — dispatch-order determinism across a resume
@@ -552,6 +582,33 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                         cheap_cfg[rid] = server._normalize(
                             admission.cheapened(req_by_rid[rid].config))
                     bucket.append(_Pending(rid, bool(degraded)))
+
+    # --- lineage inputs: the panels are ONE source artifact per drain
+    # (registered after a resume restored the ledger, so the idempotent
+    # re-registration keeps resumed ledgers byte-equal), configs are
+    # fingerprinted lazily at their first dispatch and memoized per
+    # (rid, degraded) — the degraded rewrite is a different artifact
+    panels_id = None
+    lin_mesh = None
+    lin_cfg_ids: dict = {}
+    if ledger is not None:
+        panels_fp = getattr(server, "panels_fingerprint", None)
+        if panels_fp is not None:
+            panels_id = ledger.source(panels_fp(), "panels")
+        stats_fn = getattr(server, "serving_stats", None)
+        if stats_fn is not None:
+            lin_mesh = stats_fn().get("mesh_shape")
+
+    def lin_config_id(rid: int, degraded: bool) -> str:
+        key = (rid, degraded)
+        cid = lin_cfg_ids.get(key)
+        if cid is None:
+            cfg = (cheap_cfg if degraded else normalized)[rid]
+            cid = ledger.source(
+                _ckpt.fingerprint(*_config_leaves(cfg)), "config",
+                degraded=bool(degraded))
+            lin_cfg_ids[key] = cid
+        return cid
 
     def verdict(rid: int, kind: str, *, done_s: float, rung=None,
                 dispatch=None, detail: str = "") -> None:
@@ -863,6 +920,15 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                 **({"qp_solves": 0.0} if qp is not None else {}),
                 **kit.ledger_memo[name])
         stale_enabled = SERVE_STALE in admission.ladder
+        host_books = None
+        if ledger is not None:
+            # ONE batched device->host transfer of the rung's weight
+            # books; the per-lane fingerprint then hashes a host slice —
+            # byte-identical to transferring each lane separately, but
+            # without per-lane dispatch overhead (the 2% bound's margin)
+            books = getattr(getattr(out, "sim", None), "weights", None)
+            if books is not None:
+                host_books = np.asarray(books)
         for lane, p in enumerate(chunk):
             out_lane = _tree_lane(out, lane)
             outputs[p.rid] = out_lane
@@ -873,6 +939,22 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             verdict(p.rid, kind, done_s=t_done, rung=rung,
                     dispatch=dispatch_idx,
                     detail="cheap_fallback" if p.degraded else "")
+            if ledger is not None:
+                # one content-addressed edge per delivered lane:
+                # book-fingerprint <- {panels, config}, stamped with the
+                # executable identity and the reqtrace dispatch id
+                ledger.edge(
+                    _ckpt.fingerprint(*([host_books[lane]]
+                                        if host_books is not None
+                                        else _book_leaves(out_lane))),
+                    "dispatch",
+                    [i for i in (panels_id,
+                                 lin_config_id(p.rid, p.degraded))
+                     if i is not None],
+                    code={"static_key": tag, "bucket": name,
+                          "rung": int(rung), "mesh": lin_mesh},
+                    trace={"dispatch": int(dispatch_idx)},
+                    rid=int(p.rid), tenant=r.label)
         _remove_from_pending(skey, chunk)
         record_stage("serve/queue/dispatch", kind="stage",
                      entry_point=name, rung=rung, configs=len(chunk),
@@ -938,6 +1020,10 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             # straight-through run's (one JSON string — cheap to encode,
             # and exact floats inside)
             state["flight"] = kit.state()
+        if ledger is not None:
+            # same seam, same contract: the resumed ledger must be
+            # byte-equal to a straight-through run's
+            state["lineage"] = ledger.state()
         return state
 
     # ------------------------------------------------------ the event loop
@@ -980,12 +1066,31 @@ def run_queued(server, requests, *, admission=None, service_model=None,
         row["served_p50_s"] = _round(served_sk.quantile(0.5))
         row["served_p99_s"] = _round(served_sk.quantile(0.99))
     row["virtual_makespan_s"] = _round(clock.now_s)
+    traffic = None
     if not stopped_early:
         # an early-stopped (test-seam) run must not emit the serving row:
         # its verdict counts cannot sum to the submissions yet, which is
         # exactly the malformed shape trace_report --strict rejects
         record_stage(queue_name, kind="serving", **row)
+        # the arrival trace: every submitted request's identity, EXACT
+        # (unrounded — JSON round-trips doubles exactly) arrival/deadline
+        # seconds, bucket key, and final verdict. Plain host data, so it
+        # is recorded unconditionally; replay_traffic re-submits it.
+        final = {v["rid"]: v["verdict"] for v in verdict_log}
+        traffic = []
+        for r in requests:
+            cfg = normalized.get(r.rid)
+            traffic.append(
+                {"kind": "traffic", "name": queue_name, "rid": int(r.rid),
+                 "tenant": None if r.tenant is None else str(r.tenant),
+                 "arrival_s": float(r.arrival_s),
+                 "deadline_s": float(r.deadline_s),
+                 "static_key": (None if cfg is None
+                                else repr(cfg.static_key())),
+                 "verdict": final[r.rid]})
         rep = active_report()
+        if rep is not None:
+            rep.rows.extend(dict(t) for t in traffic)
         if rep is not None and rep.latency is not None:
             for scope, sk in sketches.items():
                 rep.latency.sketches.setdefault(
@@ -994,8 +1099,52 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             # the flight rows land only on a COMPLETE drain — a partial
             # trace set is exactly the orphan shape --strict rejects
             rep.rows.extend(kit.rows(queue_name))
+        if rep is not None and ledger is not None:
+            # lineage rows follow the same complete-drain rule: a partial
+            # ledger is exactly the dangling shape --strict rejects
+            rep.rows.extend(ledger.rows(queue_name))
     return QueueResult(verdicts=verdict_log, outputs=outputs,
-                       counters=row, clock_s=clock.now_s, flight=kit)
+                       counters=row, clock_s=clock.now_s, flight=kit,
+                       traffic=traffic, lineage=ledger)
+
+
+# ---------------------------------------------------- recorded-traffic replay
+
+
+def replay_traffic(server, rows, configs, *, name=None,
+                   **kwargs) -> QueueResult:
+    """Re-submit a recorded ``kind="traffic"`` arrival trace through
+    :func:`run_queued`.
+
+    ``rows`` may be a full report's rows — only ``kind="traffic"`` rows
+    (optionally filtered to queue ``name``) are replayed. ``configs``
+    supplies each rid's config (a sequence or mapping indexed by rid):
+    the trace records content identity (``static_key``) but not the
+    config bytes, so the caller provides them — ``replay_traffic`` is a
+    re-SUBMISSION harness, not an archive reader. With the same policy
+    kwargs as the recorded run (admission, service model, fault plan,
+    retries, seeds), the replay's verdict log is byte-equal to the
+    recorded run's (test-pinned) — the recorded-traffic input the
+    pad-ladder optimizer consumes.
+    """
+    trows = [r for r in rows if r.get("kind") == "traffic"
+             and (name is None or r.get("name") == name)]
+    if not trows:
+        raise ValueError("replay_traffic: no kind=\"traffic\" rows"
+                         + (f" named {name!r}" if name is not None else ""))
+    reqs = []
+    for row in trows:
+        rid = int(row["rid"])
+        try:
+            cfg = configs[rid]
+        except (KeyError, IndexError):
+            raise ValueError(f"replay_traffic: no config for rid "
+                             f"{rid}") from None
+        reqs.append(Request(rid=rid, config=cfg,
+                            arrival_s=float(row["arrival_s"]),
+                            deadline_s=float(row["deadline_s"]),
+                            tenant=row.get("tenant")))
+    return run_queued(server, reqs, **kwargs)
 
 
 # --------------------------------------------------- flight cost sources
@@ -1052,6 +1201,19 @@ def _flatten_output(out) -> list:
     import jax
 
     return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(out)]
+
+
+def _book_leaves(out_lane) -> list:
+    """The published BOOK of one served lane — the daily weight panel —
+    as host leaves for content addressing. The book is the artifact
+    downstream consumers act on; hashing it alone (one [D, N] array, not
+    all ~33 output leaves) is what keeps the per-lane provenance cost
+    inside the 2% obs_overhead bound. Falls back to the full output tree
+    for lanes that are not ResearchOutputs."""
+    book = getattr(getattr(out_lane, "sim", None), "weights", None)
+    if book is None:
+        return _flatten_output(out_lane)
+    return [np.asarray(book)]
 
 
 def _rehang_output(server, config: TenantConfig, leaves):

@@ -56,6 +56,13 @@ def test_default_targets_cover_examples_and_obs_layer():
             "reqtrace.py", "metering.py"} <= names
     dirs = {p.parent.name for p in targets}
     assert {"examples", "obs", "tools"} <= dirs
+    # round 20: BOTH provenance modules — the ledger rides the obs glob,
+    # the explain/strict CLI rides the tools glob; pinned by parent so a
+    # move out of either directory can't silently shrink the surface
+    # (the ledger is stdlib-only and must never grow an ambient clock:
+    # content addresses are pure functions of bytes, not of time)
+    assert {p.parent.name for p in targets
+            if p.name == "lineage.py"} == {"obs", "tools"}
 
 
 def test_default_targets_cover_the_pallas_kernel_modules():

@@ -1,0 +1,561 @@
+"""The round-20 provenance ledger (``obs/lineage.py``, docs §26), its
+producing layers, and the two strict tools that audit it.
+
+Contract pinned here:
+
+- **content addressing** (the acceptance criterion): every queue
+  dispatch edge's ``output_id`` is the ``resil.fingerprint`` of the
+  delivered BOOK (the lane's ``sim.weights`` panel) — recomputable from
+  the served output — and its inputs resolve to recorded panel/config
+  sources (``ledger_errors`` empty);
+- **recorded traffic**: every complete drain emits one ``kind="traffic"``
+  row per submitted request, reconciled against the serving summary
+  (``traffic_errors`` empty), and ``replay_traffic`` re-submits the
+  trace with a BYTE-equal verdict log;
+- **kill/resume**: the ledger rides the queue checkpoint — both the
+  in-process stop seam and a real SIGKILL'd subprocess resume to a
+  ledger byte-equal to an uninterrupted run's, and ``tools/lineage.py
+  explain`` walks the chain across the boundary;
+- **strict tooling**: clean reports pass both ``tools/lineage.py
+  strict`` and ``tools/trace_report.py --strict``; ONE flipped byte —
+  in an edge's input id, or in an on-disk ``--artifacts`` file — exits
+  1 naming the broken edge;
+- **structural elision**: the default queue path (``lineage=None``)
+  serves bit-identically with ``obs.lineage`` made unimportable;
+- **online chain**: each applied date's edge consumes the previous
+  application's output id (the ring-snapshot fingerprint), restatement
+  replays supersede the edges they correct, and the ledger survives the
+  engine's kill/resume byte-equal;
+- **cross-version headers**: the meta row carries a
+  ``code_fingerprint`` and ``report_diff`` flags comparisons across
+  different installed source trees.
+
+Named ``test_serve_lineage`` (not ``test_lineage``) so it COLLECTS
+AFTER ``tests/test_serve.py``: the serving modules here reuse the
+bucket static keys of the serve suite over a DIFFERENT market, and the
+value-keyed executable cache then legitimately compiles the same
+``serve/bucket/*`` entry point a second time — which test_serve.py's
+absolute no-retrace pin (``expected_signatures=1``) must not observe
+before its own module runs.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from factormodeling_tpu import obs
+from factormodeling_tpu.obs import lineage as obs_lineage
+from factormodeling_tpu.obs import regression
+from factormodeling_tpu.obs.report import code_fingerprint
+from factormodeling_tpu.online import OnlineEngine
+from factormodeling_tpu.resil import DispatchFaultPlan
+from factormodeling_tpu.resil.checkpoint import fingerprint
+from factormodeling_tpu.serve import TenantConfig, TenantServer
+from factormodeling_tpu.serve.admission import AdmissionPolicy
+from factormodeling_tpu.serve.queue import (
+    bursty_arrivals,
+    make_requests,
+    replay_traffic,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+LINEAGE_CLI = str(REPO / "tools" / "lineage.py")
+TRACE_CLI = str(REPO / "tools" / "trace_report.py")
+
+F, D, N, WINDOW = 5, 30, 8, 6
+NAMES = ("fam0_f0_flx", "fam0_f1_eq", "fam1_f2_flx", "fam1_f3_long",
+         "fam2_f4_flx")
+LADDER = (1, 4, 8)
+SERVICE = 0.05
+
+
+def make_market(rng, *, d=D, n=N, f=F):
+    factors = rng.normal(size=(f, d, n))
+    factors[rng.uniform(size=factors.shape) < 0.05] = np.nan
+    return dict(
+        factors=factors,
+        returns=rng.normal(scale=0.02, size=(d, n)),
+        factor_ret=rng.normal(scale=0.01, size=(d, f)),
+        cap_flag=rng.integers(1, 4, size=(d, n)).astype(float),
+        investability=np.ones((d, n)),
+        universe=rng.uniform(size=(d, n)) > 0.05,
+    )
+
+
+@pytest.fixture(scope="module")
+def market():
+    # same seed as tests/test_serve_queue.py: every TenantServer over it
+    # shares the value-keyed executable cache across the whole session
+    return make_market(np.random.default_rng(20260804))
+
+
+def mk_server(market, **kw):
+    kw.setdefault("pad_ladder", LADDER)
+    return TenantServer(names=NAMES, **market, **kw)
+
+
+def equal_cfg(i=0, **kw):
+    kw.setdefault("method", "equal")
+    kw.setdefault("window", WINDOW)
+    kw.setdefault("icir_threshold", -1.0)
+    kw.setdefault("top_k", 1 + i % F)
+    return TenantConfig(**kw)
+
+
+def const_service(_tag, _rung):
+    return SERVICE
+
+
+def run_cli(*argv):
+    return subprocess.run([sys.executable, *argv], capture_output=True,
+                          text=True, timeout=120)
+
+
+# --------------------------------------------- ledger checker unit tests
+
+
+def test_ledger_errors_catch_dangling_and_cycles():
+    led = obs_lineage.LineageLedger()
+    a = led.source("a" * 16, "panels")
+    led.edge("b" * 16, "dispatch", [a])
+    rows = led.rows("u")
+    assert obs_lineage.ledger_errors(rows) == []
+    # ONE flipped reference: the input no longer resolves
+    bad = [dict(r) for r in rows]
+    bad[-1]["inputs"] = ["f" * 16]
+    errs = obs_lineage.ledger_errors(bad)
+    assert len(errs) == 1 and "dangling edge" in errs[0]
+    assert "b" * 16 in errs[0]
+    # dangling supersedes is its own finding
+    sup = [dict(r) for r in rows]
+    sup[-1]["supersedes"] = "e" * 16
+    assert any("supersedes unknown" in e
+               for e in obs_lineage.ledger_errors(sup))
+    # a derivation loop can never come from the ledger API (every input
+    # must pre-exist) but a corrupted artifact can hold one
+    cyc = [{"kind": "lineage", "name": "u", "seq": 0, "edge_kind": "x",
+            "output_id": "1" * 16, "inputs": ["2" * 16]},
+           {"kind": "lineage", "name": "u", "seq": 1, "edge_kind": "x",
+            "output_id": "2" * 16, "inputs": ["1" * 16]}]
+    assert any("cycle" in e for e in obs_lineage.ledger_errors(cyc))
+    # ledgers are per-name: the same broken rows under different names
+    # are reported per scope, never cross-resolved
+    other = [dict(r, name="v") for r in bad]
+    assert len(obs_lineage.ledger_errors(bad + other)) == 2
+
+
+def test_traffic_errors_reconcile_against_the_serving_summary():
+    srow = {"kind": "serving", "name": "q", "submitted": 2, "served": 1,
+            "shed_count": 1, "deadline_miss_count": 0, "failed_count": 0}
+    t0 = {"kind": "traffic", "name": "q", "rid": 0, "arrival_s": 0.0,
+          "deadline_s": 1.0, "verdict": "SERVED"}
+    t1 = dict(t0, rid=1, verdict="SHED")
+    assert obs_lineage.traffic_errors([srow, t0, t1]) == []
+    # a lost row breaks the submitted count AND its verdict tally
+    errs = obs_lineage.traffic_errors([srow, t0])
+    assert any("1 traffic rows != 2 submitted" in e for e in errs)
+    assert any("shed_count" in e for e in errs)
+    # traffic without its summary row is half the evidence gone
+    assert obs_lineage.traffic_errors([t0, t1]) == [
+        "traffic q: 2 traffic rows but no serving summary row"]
+
+
+# ------------------------------------------- queue edges + traffic rows
+
+
+@pytest.fixture(scope="module")
+def lineage_report(market, tmp_path_factory):
+    """ONE flight+lineage drain shared by the tool tests: its report
+    JSONL (meta header, serving/traffic/lineage/reqtrace rows) and the
+    QueueResult it came from."""
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(8)]
+    rep = obs.RunReport("lineage-report", latency=True)
+    with rep.activate():
+        res = server.serve_queued(
+            make_requests(cfgs, np.arange(8.0) * 0.2, deadline_s=30.0),
+            service_model=const_service, flight=True, lineage=True)
+    path = tmp_path_factory.mktemp("lineage") / "report.jsonl"
+    rep.write_jsonl(path)
+    return path, res
+
+
+def test_queue_edges_content_address_the_published_book(lineage_report):
+    path, res = lineage_report
+    rows = [json.loads(ln) for ln in
+            path.read_text().strip().splitlines()]
+    assert obs_lineage.ledger_errors(rows) == []
+    assert obs_lineage.traffic_errors(rows) == []
+    disp = [r for r in rows if r.get("kind") == "lineage"
+            and r.get("edge_kind") == "dispatch"]
+    assert {r["rid"] for r in disp} == set(range(8))
+    for r in disp:
+        # the output id IS the book's fingerprint — recomputable from
+        # the served output, which is what --artifacts re-proves
+        book = np.asarray(res.outputs[r["rid"]].sim.weights)
+        assert r["output_id"] == fingerprint(book)
+        assert r["inputs"], "a dispatch must consume panel+config sources"
+        assert set(r["code"]) >= {"static_key", "bucket", "rung", "mesh"}
+        assert isinstance(r["trace"]["dispatch"], int)
+    # the arrival trace is ALWAYS on: one row per submitted request
+    traffic = [r for r in rows if r.get("kind") == "traffic"]
+    assert len(traffic) == 8 == len(res.traffic)
+    assert all(r["verdict"] == "SERVED" for r in traffic)
+
+
+def test_replay_traffic_reproduces_the_verdict_log_byte_equal(market):
+    server = mk_server(market)
+    cfgs = [equal_cfg(i, pct=0.1 + 0.02 * (i % 3)) if i % 3
+            else equal_cfg(i) for i in range(12)]
+    arrivals = bursty_arrivals(12, rate_hz=1.2 * LADDER[-1] / SERVICE,
+                               burst=4, seed=13)
+    kw = dict(admission=AdmissionPolicy(max_depth=6),
+              service_model=const_service,
+              fault_plan=DispatchFaultPlan(seed=5, error_rate=0.25),
+              retries=2)
+    rec = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7), **kw)
+    assert rec.traffic is not None and len(rec.traffic) == 12
+    # same policy kwargs + the recorded trace = the same run, byte-equal
+    # verdicts included faults and retries
+    rep = replay_traffic(server, rec.traffic, cfgs, **kw)
+    assert rep.log_lines() == rec.log_lines()
+    with pytest.raises(ValueError, match="no kind"):
+        replay_traffic(server, [], cfgs)
+
+
+def test_queue_stop_resume_ledger_byte_equal(market, tmp_path):
+    """In-process half of the kill/resume differential, lineage ON: the
+    ledger rides the checkpoint, so the resumed run's ledger state — and
+    the verdict log — are BYTE-equal to an uninterrupted run's."""
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(12)]
+    arrivals = bursty_arrivals(12, rate_hz=1.2 * LADDER[-1] / SERVICE,
+                               burst=5, seed=11)
+    kw = dict(admission=AdmissionPolicy(max_depth=10),
+              service_model=const_service,
+              fault_plan=DispatchFaultPlan(seed=2, error_rate=0.3),
+              retries=2, lineage=True)
+    straight = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7), **kw)
+    ck = tmp_path / "queue.ckpt"
+    partial = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7),
+        checkpoint_path=ck, _stop_after_dispatches=1, **kw)
+    assert len(partial.verdicts) < 12 and ck.exists()
+    resumed = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7),
+        checkpoint_path=ck, **kw)
+    assert resumed.log_lines() == straight.log_lines()
+    assert resumed.lineage.state() == straight.lineage.state()
+    rows = resumed.lineage.rows("resume/queue")
+    assert rows and obs_lineage.ledger_errors(rows) == []
+
+
+def test_sigkill_resume_explain_crosses_the_boundary(market, tmp_path):
+    """The out-of-process half: a server SIGKILL'd mid-drain
+    (``_FMT_SERVE_DIE_AFTER_DISPATCH``) leaves its ledger in the
+    snapshot; the resumed process finishes the drain, the combined
+    ledger is byte-equal to an uninterrupted run's, and the explain CLI
+    walks a post-resume book back to pre-kill sources."""
+    market_path = tmp_path / "market.npz"
+    np.savez(market_path, **{k: np.asarray(v) for k, v in market.items()})
+    ck = tmp_path / "queue.ckpt"
+    script = f"""
+import sys
+sys.path.insert(0, {str(REPO)!r})
+import os
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from factormodeling_tpu.serve import TenantConfig, TenantServer
+from factormodeling_tpu.serve.queue import make_requests
+market = np.load({str(market_path)!r}, allow_pickle=False)
+server = TenantServer(names={NAMES!r}, pad_ladder={LADDER!r},
+                      **{{k: market[k] for k in market.files}})
+cfgs = [TenantConfig(top_k=1 + i % {F}, icir_threshold=-1.0,
+                     method="equal", window={WINDOW}) for i in range(8)]
+server.serve_queued(make_requests(cfgs, np.arange(8.0) * 0.2,
+                                  deadline_s=30.0),
+                    service_model=lambda _t, _r: {SERVICE},
+                    checkpoint_path={str(ck)!r}, lineage=True)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=420, env={**__import__("os").environ,
+                          "_FMT_SERVE_DIE_AFTER_DISPATCH": "0"})
+    assert proc.returncode == 137, proc.stderr[-2000:]
+    assert "dying after dispatch 0" in proc.stdout
+    assert ck.exists()
+
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(8)]
+    reqs = lambda: make_requests(cfgs, np.arange(8.0) * 0.2,
+                                 deadline_s=30.0)
+    rep = obs.RunReport("sigkill-resume")
+    with rep.activate():
+        resumed = server.serve_queued(
+            reqs(), service_model=const_service, checkpoint_path=ck,
+            lineage=True)
+    straight = server.serve_queued(reqs(), service_model=const_service,
+                                   lineage=True)
+    assert resumed.log_lines() == straight.log_lines()
+    # the pre-kill edges came from ANOTHER process: byte-equality here
+    # is the cross-process bit-identity pin for content addressing
+    assert resumed.lineage.state() == straight.lineage.state()
+    report = tmp_path / "resumed.jsonl"
+    rep.write_jsonl(report)
+    strict = run_cli(LINEAGE_CLI, "strict", str(report))
+    assert strict.returncode == 0, strict.stderr[-2000:]
+    explain = run_cli(LINEAGE_CLI, "explain", str(report), "--rid", "7")
+    assert explain.returncode == 0, explain.stderr[-2000:]
+    assert "rid=7" in explain.stdout and "source" in explain.stdout
+
+
+def test_default_queue_path_elides_the_lineage_module(market, tmp_path):
+    """PR 7-style unimportable pin: with ``obs.lineage`` BLOCKED from
+    importing, the default drain (``lineage=None``) still serves — books
+    bit-identical to a lineage-ON run — and still records traffic rows.
+    Provenance is pure opt-in bookkeeping the hot path never touches."""
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(3)]
+    res = server.serve_queued(
+        make_requests(cfgs, np.arange(3.0) * 0.2, deadline_s=30.0),
+        service_model=const_service, lineage=True)
+    want = np.nan_to_num(np.asarray(res.outputs[2].sim.weights))
+    market_path = tmp_path / "market.npz"
+    weights_path = tmp_path / "weights.npy"
+    np.savez(market_path, **{k: np.asarray(v) for k, v in market.items()})
+    script = f"""
+import sys
+sys.path.insert(0, {str(REPO)!r})
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name == "factormodeling_tpu.obs.lineage":
+            raise ImportError(f"{{name}} is blocked for the elision pin")
+        return None
+sys.meta_path.insert(0, _Block())
+import os
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from factormodeling_tpu.serve import TenantConfig, TenantServer
+from factormodeling_tpu.serve.queue import make_requests
+market = np.load({str(market_path)!r}, allow_pickle=False)
+server = TenantServer(names={NAMES!r}, pad_ladder={LADDER!r},
+                      **{{k: market[k] for k in market.files}})
+cfgs = [TenantConfig(top_k=1 + i % {F}, icir_threshold=-1.0,
+                     method="equal", window={WINDOW}) for i in range(3)]
+res = server.serve_queued(make_requests(cfgs, np.arange(3.0) * 0.2,
+                                        deadline_s=30.0),
+                          service_model=lambda _t, _r: {SERVICE})
+assert "factormodeling_tpu.obs.lineage" not in sys.modules
+assert res.lineage is None and len(res.traffic) == 3
+np.save({str(weights_path)!r},
+        np.nan_to_num(np.asarray(res.outputs[2].sim.weights)))
+print("ELISION_OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ELISION_OK" in proc.stdout
+    np.testing.assert_array_equal(np.load(weights_path), want)
+
+
+# --------------------------------------------------- the strict tooling
+
+
+def test_clean_report_passes_both_strict_tools(lineage_report):
+    path, _ = lineage_report
+    strict = run_cli(LINEAGE_CLI, "strict", str(path))
+    assert strict.returncode == 0, strict.stderr[-2000:]
+    tr = run_cli(TRACE_CLI, str(path), "--strict")
+    assert tr.returncode == 0, tr.stderr[-2000:]
+    # the human rendering grew provenance sections
+    assert "provenance ledger" in tr.stdout
+    assert "recorded traffic" in tr.stdout
+
+
+def test_one_flipped_byte_fails_both_strict_tools(lineage_report,
+                                                  tmp_path):
+    path, _ = lineage_report
+    rows = [json.loads(ln) for ln in
+            path.read_text().strip().splitlines()]
+    victim = next(r for r in rows if r.get("kind") == "lineage"
+                  and r.get("inputs"))
+    victim["inputs"] = ["0" * 16] + victim["inputs"][1:]
+    tampered = tmp_path / "tampered.jsonl"
+    tampered.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    strict = run_cli(LINEAGE_CLI, "strict", str(tampered))
+    assert strict.returncode == 1
+    assert "dangling edge" in strict.stderr
+    assert victim["output_id"] in strict.stderr  # names the broken edge
+    tr = run_cli(TRACE_CLI, str(tampered), "--strict")
+    assert tr.returncode == 1
+    assert "provenance" in tr.stderr
+
+
+def test_artifact_recompute_catches_a_flipped_byte(lineage_report,
+                                                   tmp_path):
+    path, res = lineage_report
+    rows = [json.loads(ln) for ln in
+            path.read_text().strip().splitlines()]
+    edge = next(r for r in rows if r.get("kind") == "lineage"
+                and r.get("edge_kind") == "dispatch" and r["rid"] == 4)
+    book = np.asarray(res.outputs[4].sim.weights)
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    np.save(art / f"{edge['output_id']}.npy", book)
+    clean = run_cli(LINEAGE_CLI, "strict", str(path),
+                    "--artifacts", str(art))
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    # flip ONE byte of the on-disk book — same dtype, same shape
+    buf = bytearray(book.tobytes())
+    buf[7] ^= 1
+    np.save(art / f"{edge['output_id']}.npy",
+            np.frombuffer(bytes(buf), dtype=book.dtype
+                          ).reshape(book.shape))
+    bad = run_cli(LINEAGE_CLI, "strict", str(path),
+                  "--artifacts", str(art))
+    assert bad.returncode == 1
+    assert edge["output_id"] in bad.stderr
+
+
+def test_explain_cli_joins_the_reqtrace_span(lineage_report):
+    path, _ = lineage_report
+    explain = run_cli(LINEAGE_CLI, "explain", str(path), "--rid", "5")
+    assert explain.returncode == 0, explain.stderr[-2000:]
+    out = explain.stdout
+    assert "dispatch" in out and "rid=5" in out
+    # the flight recorder ran, so the edge names its causal span
+    assert "reqtrace" in out
+    assert "source" in out  # the walk reaches raw-input fingerprints
+
+
+# ----------------------------------------------------- the online chain
+
+
+ON_F, ON_D, ON_N = 6, 24, 12
+ON_NAMES = tuple(f"fac{i}{s}" for i, s in
+                 enumerate(("_eq", "_flx", "_long", "_short", "_eq",
+                            "_flx")))
+
+
+def online_market(seed=7):
+    rng = np.random.default_rng(seed)
+    fac = rng.normal(size=(ON_F, ON_D, ON_N))
+    ret = rng.normal(scale=0.02, size=(ON_D, ON_N))
+    cap = rng.integers(1, 4, size=(ON_D, ON_N)).astype(float)
+    invest = np.ones((ON_D, ON_N))
+    fr = rng.normal(scale=0.01, size=(ON_D, ON_F))
+    return fac, ret, cap, invest, fr
+
+
+def online_slice(t, market):
+    import jax.numpy as jnp
+
+    from factormodeling_tpu.online import DateSlice
+    fac, ret, cap, invest, fr = market
+    return DateSlice(
+        factors=jnp.asarray(fac[:, t, :]), returns=jnp.asarray(ret[t]),
+        factor_ret=jnp.asarray(fr[t]), cap_flag=jnp.asarray(cap[t]),
+        investability=jnp.asarray(invest[t]), universe=None)
+
+
+def online_feed(eng, market, dates=None):
+    for t in (range(ON_D) if dates is None else dates):
+        eng.ingest(t, online_slice(t, market))
+
+
+def test_online_chain_links_and_restatement_supersedes():
+    tmpl = TenantConfig(window=6, lookback_period=6)
+    market = online_market()
+    eng = OnlineEngine(names=ON_NAMES, n_assets=ON_N, template=tmpl,
+                       horizon=5, lineage=True)
+    online_feed(eng, market)
+    fac, ret, cap, invest, fr = market
+    fac2 = fac.copy()
+    fac2[:, ON_D - 3, :] *= 1.5
+    corrected = (fac2, ret, cap, invest, fr)
+    v = eng.ingest(ON_D - 3, online_slice(ON_D - 3, corrected),
+                   restate=True)
+    assert v.status == "replayed"
+    rows = eng.lineage_rows("online/lineage")
+    assert obs_lineage.ledger_errors(rows) == []
+    applied = {r["date"]: r for r in rows
+               if r.get("edge_kind") == "applied"}
+    assert set(applied) == set(range(ON_D))
+    # each application consumes the PREVIOUS application's output id —
+    # the ring-snapshot fingerprint IS the prior state's content address
+    genesis = next(r for r in rows if r.get("edge_kind") == "source"
+                   and r.get("what") == "state_genesis")
+    assert applied[0]["inputs"][0] == genesis["output_id"]
+    for d in range(1, ON_D):
+        assert applied[d]["inputs"][0] == applied[d - 1]["output_id"]
+    # the restatement's replays SUPERSEDE the edges they correct, for
+    # every replayed tail date — the audit trail keeps both
+    replayed = [r for r in rows if r.get("edge_kind") == "replayed"]
+    assert {r["date"] for r in replayed} == {ON_D - 3, ON_D - 2,
+                                             ON_D - 1}
+    for r in replayed:
+        assert r["supersedes"] == applied[r["date"]]["output_id"]
+    # the replay tally is sampled at emission, so it climbs across the
+    # replayed tail rather than pinning one value per edge
+    assert max(r["state"]["replays"] for r in replayed) >= 1
+    assert all("version" in r["state"] and "chain" in r["state"]
+               for r in replayed)
+
+
+def test_online_kill_resume_ledger_byte_equal(tmp_path):
+    tmpl = TenantConfig(window=6, lookback_period=6)
+    market = online_market()
+    ck = tmp_path / "engine.snap"
+    k = ON_D // 2
+    eng = OnlineEngine(names=ON_NAMES, n_assets=ON_N, template=tmpl,
+                       horizon=4, checkpoint=ck, lineage=True)
+    online_feed(eng, market, dates=range(k + 1))
+    del eng  # SIGKILL stand-in: only the snapshot survives
+    resumed = OnlineEngine(names=ON_NAMES, n_assets=ON_N, template=tmpl,
+                           horizon=4, checkpoint=ck, lineage=True)
+    assert resumed.last_date == k
+    n_edges = len(resumed.lineage_rows())
+    dup = resumed.ingest(k, online_slice(k, market))
+    assert dup.status == "rejected"
+    # a rejected duplicate is NOT a derivation: no edge appears
+    assert len(resumed.lineage_rows()) == n_edges
+    online_feed(resumed, market, dates=range(k + 1, ON_D))
+    straight = OnlineEngine(names=ON_NAMES, n_assets=ON_N, template=tmpl,
+                            horizon=4, lineage=True)
+    online_feed(straight, market)
+    assert resumed._lineage.state() == straight._lineage.state()
+    rows = resumed.lineage_rows("online/lineage")
+    assert obs_lineage.ledger_errors(rows) == []
+
+
+# ------------------------------------------- cross-version meta headers
+
+
+def test_meta_header_carries_a_code_fingerprint_and_diff_notes_it():
+    fp = code_fingerprint()
+    assert isinstance(fp, str) and len(fp) == 16
+    int(fp, 16)  # hex digest prefix
+    rep = obs.RunReport("meta-fp")
+    assert rep.header()["code_fingerprint"] == fp
+    base = [dict(rep.header(), code_fingerprint="0" * 16)]
+    new = [rep.header()]
+    result = regression.diff_reports(base, new)
+    notes = [f for f in result.findings
+             if f.name == "code_fingerprint"]
+    assert notes and "cross-version" in notes[0].detail
+    # same tree, no note
+    assert not [f for f in
+                regression.diff_reports(new, [rep.header()]).findings
+                if f.name == "code_fingerprint"]

@@ -60,6 +60,11 @@ completeness (one closed span tree per ingestion the final engine saw —
 engine traces are per-process by contract) and per-(bucket, date)
 ``advance_all`` metering conservation through a small metered two-tenant
 session (``trace_complete`` / ``metering_conserved`` in the verdict).
+Round 20: serving and online cells run with the provenance ledger on
+(``lineage=True``) and assert per-cell referential integrity — every
+edge's input ids resolve, chains are acyclic (``lineage_intact`` in the
+verdict); a fault-injected or killed-and-resumed cell must never record
+a dangling derivation.
 
 ``--scenarios`` switches to the round-16 SCENARIO preset
 (``factormodeling_tpu.scenarios``, architecture.md §22): each cell runs a
@@ -479,7 +484,7 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                 service_model=lambda _tag, _rung: service_s,
                 fault_plan=_serving_fault_plan(resil, fault, seed + idx),
                 retries=2, checkpoint_path=cell_ck,
-                queue_name=f"chaos/{cell}", flight=True)
+                queue_name=f"chaos/{cell}", flight=True, lineage=True)
 
             c = res.counters
             violations: list[str] = []
@@ -499,6 +504,15 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                 res.flight.meter.row(cell))
             if conserve:
                 violations.extend(conserve[:4])
+            # round 20: the cell's provenance ledger must be referentially
+            # sound — every input id a dispatch edge references resolves
+            # to a recorded source/edge, even with faults injected
+            from factormodeling_tpu.obs import lineage as obs_lineage
+
+            lin_errs = obs_lineage.ledger_errors(
+                res.lineage.rows(f"chaos/{cell}"))
+            if lin_errs:
+                violations.extend(lin_errs[:4])
             by_rid = res.by_rid()
             if sorted(by_rid) != list(range(n_requests)):
                 violations.append("verdict completeness: not every rid "
@@ -537,6 +551,7 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                       "ok": not violations, "violations": violations,
                       "trace_complete": bool(trace_complete),
                       "metering_conserved": not conserve,
+                      "lineage_intact": not lin_errs,
                       **{k: int(c[k]) for k in
                          ("submitted", "served", "shed_count",
                           "deadline_miss_count", "failed_count",
@@ -885,7 +900,7 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                         guards=guards[pol_name], checkpoint=ck_file,
                         retain_history=True, dtype=np.float32,
                         progress=lambda msg: progress(f"{cell}: {msg}"),
-                        flight=True)
+                        flight=True, lineage=True)
 
                 eng = make_engine()
                 # the recorder is per-process: the final engine's trace
@@ -966,6 +981,16 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                         f"errors {trace_errors[:2]}")
                 meter_errors = metered_advance_errors()
                 violations.extend(meter_errors)
+                # round 20: the cell's provenance chain — every applied/
+                # replayed date's prev-state and date-slice ids resolve,
+                # the chain stays acyclic, across the in-process restart
+                # (the ledger rides the engine checkpoint)
+                from factormodeling_tpu.obs import lineage as obs_lineage
+
+                lin_rows = eng.lineage_rows(f"chaos/{cell}/lineage")
+                lin_errs = obs_lineage.ledger_errors(lin_rows)
+                if lin_errs:
+                    violations.extend(lin_errs[:4])
                 # statuses derive from the engine's GLOBAL counters, not
                 # the verdicts this process saw: a killed-and-resumed
                 # cell's stdout must be byte-equal to a straight-through
@@ -978,6 +1003,7 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                     "ok": not violations, "violations": violations,
                     "trace_complete": bool(trace_complete),
                     "metering_conserved": not meter_errors,
+                    "lineage_intact": not lin_errs,
                     "statuses": statuses,
                     "counters": {k: int(v)
                                  for k, v in sorted(eng.counters.items())},
@@ -994,6 +1020,7 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                 rep.record(f"chaos/{cell}", kind="online",
                            **eng.report_fields())
                 rep.rows.extend(eng.flight_rows(f"chaos/{cell}/trace"))
+                rep.rows.extend(lin_rows)
                 progress(f"{cell}: "
                          f"{'ok' if result['ok'] else 'FAIL'} "
                          f"(statuses={statuses})")

@@ -1,0 +1,231 @@
+"""Explain and verify the provenance ledger of a RunReport JSONL.
+
+Usage::
+
+    python tools/lineage.py explain report.jsonl [--tenant T] [--date D]
+        [--rid R] [--output-id ID] [--name NAME]
+    python tools/lineage.py strict report.jsonl [--artifacts DIR]
+
+``explain`` walks the chain from a published artifact — a served tenant's
+book, an online date's state, a scenario chunk — back to raw input
+fingerprints and prints the causal story, one line per derivation edge,
+across kill/resume boundaries (the ledger rides the checkpoint, so a
+resumed run's chain is unbroken). Reqtrace rows in the same report are
+joined by dispatch id, so each dispatch edge also names its causal span.
+Selection picks the LATEST non-source edge matching the filters: a
+restated date explains its superseding replay, a tenant explains its most
+recent book.
+
+``strict`` verifies referential integrity: every referenced input id
+resolves to a recorded edge, ``supersedes`` references resolve, derivation
+chains are acyclic, and every ``kind="traffic"`` row's verdict reconciles
+with the queue's ``kind="serving"`` summary counters. With ``--artifacts
+DIR``, any file named ``<output_id>.npy`` / ``<output_id>.npz`` in DIR is
+re-fingerprinted (same dtype+shape+bytes sha256 scheme as
+``resil.checkpoint.fingerprint``; needs numpy, imported lazily) and a
+mismatch — one flipped byte anywhere — exits 1 naming the broken edge.
+HONEST LIMIT (docs/architecture.md §26): content that has left disk is
+not re-verifiable; ``strict`` proves the recorded graph is sound, and
+re-proves bytes only for artifacts still present under ``--artifacts``.
+
+Pure stdlib: the ledger checkers live in ``factormodeling_tpu/obs/
+lineage.py`` (itself stdlib-only) and are loaded standalone by file path —
+same contract as ``tools/report_diff.py`` / ``tools/trace_report.py``, so
+this tool runs anywhere the JSONL does.
+
+Exit codes: 0 = clean; 1 = broken edge / integrity or verdict mismatch
+(each named on stderr); 2 = unusable input (missing/empty report, no
+lineage rows for ``strict``, unreadable artifacts dir, numpy missing
+under ``--artifacts``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+_LIN_PATH = (Path(__file__).resolve().parent.parent / "factormodeling_tpu"
+             / "obs" / "lineage.py")
+
+
+def _load_lineage():
+    """Import obs/lineage.py WITHOUT the package __init__ (which pulls
+    jax). Same sys.modules key and cache-first semantics as the other
+    standalone tools — one process, one module identity."""
+    name = "_fmt_obs_lineage"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, _LIN_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(name, None)  # never cache a half-initialized module
+        raise
+    return mod
+
+
+def load_rows(path) -> list:
+    """Rows of a RunReport JSONL; corrupt tail lines are skipped with a
+    warning (a killed run's last line must not hide the rest)."""
+    rows = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"lineage: {path}:{lineno}: skipping corrupt line",
+                      file=sys.stderr)
+    return rows
+
+
+def _artifact_fingerprint(path: Path):
+    """Recompute the ``resil.checkpoint.fingerprint`` of one ``.npy`` /
+    ``.npz`` artifact (npz arrays fold in sorted-key order — the order
+    the producing layers fingerprint multi-array artifacts in)."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+
+    def fold(arr):
+        arr = np.asarray(arr)
+        h.update(str(arr.dtype).encode() + b"|" + str(arr.shape).encode())
+        h.update(arr.tobytes())
+
+    if path.suffix == ".npz":
+        with np.load(path) as z:
+            for key in sorted(z.files):
+                fold(z[key])
+    else:
+        fold(np.load(path))
+    return h.hexdigest()[:16]
+
+
+def artifact_errors(rows, artifacts_dir, lin) -> list:
+    """Re-fingerprint every on-disk artifact named by an edge id; a
+    mismatch names the edge whose recorded bytes no longer exist."""
+    errs = []
+    by_id: dict = {}
+    for r in lin.lineage_rows(rows):
+        oid = r.get("output_id")
+        if isinstance(oid, str) and oid:
+            by_id.setdefault(oid, r)
+    checked = 0
+    for oid, r in sorted(by_id.items()):
+        for suffix in (".npy", ".npz"):
+            path = Path(artifacts_dir) / f"{oid}{suffix}"
+            if not path.is_file():
+                continue
+            checked += 1
+            try:
+                got = _artifact_fingerprint(path)
+            except Exception as e:
+                errs.append(f"artifact {path.name}: unreadable ({e}) — "
+                            f"cannot re-verify edge "
+                            f"{r.get('edge_kind')} output_id={oid}")
+                continue
+            if got != oid:
+                errs.append(
+                    f"artifact {path.name}: recomputed fingerprint {got} "
+                    f"!= ledger id {oid} — bytes on disk no longer match "
+                    f"edge {r.get('edge_kind')} output_id={oid} "
+                    f"(name={r.get('name')!r}"
+                    + (f" seq={r['seq']}" if "seq" in r else "") + ")")
+    if checked == 0:
+        print(f"lineage: no artifacts matched any edge id under "
+              f"{artifacts_dir} — nothing re-verified", file=sys.stderr)
+    return errs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("command", choices=("explain", "strict"),
+                        help="explain = print the causal story; "
+                             "strict = verify referential integrity")
+    parser.add_argument("report", help="RunReport JSONL with "
+                                       "kind=\"lineage\" rows")
+    parser.add_argument("--tenant", default=None,
+                        help="explain: select by tenant label")
+    parser.add_argument("--date", type=int, default=None,
+                        help="explain: select by online date id")
+    parser.add_argument("--rid", type=int, default=None,
+                        help="explain: select by request id")
+    parser.add_argument("--output-id", default=None,
+                        help="explain: select by exact content id")
+    parser.add_argument("--name", default=None,
+                        help="restrict to one ledger name "
+                             "(e.g. serve/queue)")
+    parser.add_argument("--artifacts", default=None, metavar="DIR",
+                        help="strict: re-fingerprint <id>.npy/<id>.npz "
+                             "files in DIR against the ledger")
+    args = parser.parse_args(argv)
+
+    lin = _load_lineage()
+    try:
+        rows = load_rows(args.report)
+    except OSError as e:
+        print(f"lineage: cannot read report {args.report!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if not rows:
+        print(f"lineage: report {args.report!r} has no parseable rows",
+              file=sys.stderr)
+        return 2
+
+    if args.command == "explain":
+        for line in lin.explain_lines(rows, tenant=args.tenant,
+                                      date=args.date, rid=args.rid,
+                                      output_id=args.output_id,
+                                      name=args.name):
+            print(line)
+        return 0
+
+    # strict
+    lrows = lin.lineage_rows(rows)
+    if args.name is not None:
+        lrows = [r for r in lrows
+                 if str(r.get("name")) == str(args.name)]
+    if not lrows:
+        print(f"lineage: report {args.report!r} has no lineage rows"
+              + (f" for name={args.name}" if args.name else "")
+              + " — was the run recorded with lineage on?",
+              file=sys.stderr)
+        return 2
+    errs = list(lin.ledger_errors(lrows))
+    errs.extend(lin.traffic_errors(rows))
+    if args.artifacts is not None:
+        if not Path(args.artifacts).is_dir():
+            print(f"lineage: artifacts dir {args.artifacts!r} does not "
+                  f"exist", file=sys.stderr)
+            return 2
+        try:
+            errs.extend(artifact_errors(rows, args.artifacts, lin))
+        except ImportError:
+            print("lineage: --artifacts needs numpy to re-fingerprint "
+                  "arrays; not available here", file=sys.stderr)
+            return 2
+    if errs:
+        for e in errs:
+            print(f"lineage: {e}", file=sys.stderr)
+        print(f"lineage: {len(errs)} integrity error(s) in "
+              f"{args.report}", file=sys.stderr)
+        return 1
+    n_tr = len(lin.traffic_rows(rows))
+    print(f"lineage: OK — {len(lrows)} edges, {n_tr} traffic rows, "
+          f"referential integrity verified"
+          + (" (+ on-disk artifacts re-fingerprinted)"
+             if args.artifacts else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

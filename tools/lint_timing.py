@@ -257,7 +257,12 @@ def default_targets(repo_root=None) -> list[Path]:
     artifacts, and the sharded-step factories are where a quick
     "time the mesh speedup" window would land unfenced — the whole
     parallel/ glob plus the non-Pallas ops modules the asset plan
-    threads through, pinned by name in tests/test_lint_timing.py."""
+    threads through, pinned by name in tests/test_lint_timing.py. The
+    provenance modules (round 20) ride the existing globs — the obs/
+    ledger and the tools/ explain/strict CLI, pinned by parent in
+    tests/test_lint_timing.py: content addresses are pure functions of
+    bytes, so an ambient clock anywhere in that surface would be a
+    correctness bug, not just a measurement one."""
     root = Path(repo_root) if repo_root else Path(__file__).resolve().parent.parent
     pkg = root / "factormodeling_tpu"
     return ([root / "bench.py"] + sorted((root / "tools").glob("*.py"))

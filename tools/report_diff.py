@@ -43,9 +43,16 @@ speed), or a seconds-valued
 bench row beyond the ratio AND the baseline's recorded best-of-N spread
 — throughput rows with ANY ``/s`` unit (``configs/s``, ``paths/s``)
 gate on drops through the same clause —
+or a provenance ledger / arrival trace present in the baseline but
+missing from the new report (``kind="lineage"`` / ``kind="traffic"``
+rows, round 20 — a run must never silently lose its audit trail; edge
+CONTENTS are content-addressed and legitimately change with the data,
+so only per-name presence gates) —
 all exit 1 with a one-line attribution. Reports with mismatched
 ``kind="meta"`` schema versions REFUSE to gate; cross-backend pairs warn
-and skip wall gating automatically.
+and skip wall gating automatically; differing ``code_fingerprint``
+headers are NOTED as a cross-version comparison, so drift findings read
+as code-change effects rather than environment noise.
 
 Pure stdlib, no jax: the diff logic lives in
 ``factormodeling_tpu/obs/regression.py`` (itself stdlib-only) and is

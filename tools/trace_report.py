@@ -12,7 +12,10 @@ count + p50/p90/p99 + SLO verdict), the serving queue (verdict counts —
 served/shed/miss/failed must sum to submissions), the online-advance
 engine (verdict counts — applied/replayed/rejected must sum to
 ingestions, plus rejection reasons and the full-recompute fallback
-tally), device-time
+tally), the round-20 provenance ledger (``kind="lineage"`` edge counts
+per ledger name, by edge kind, with superseding-restatement tallies)
+and recorded traffic (``kind="traffic"`` arrival traces per queue, by
+verdict), device-time
 attribution, cost-analysis estimates, bench rows, and plain stage
 records print in their own sections. Pure stdlib — usable on any box that has the JSONL, no jax
 required.
@@ -21,6 +24,10 @@ required.
 traces (``kind="reqtrace"`` rows) as a Chrome-trace/Perfetto timeline —
 one thread lane per request, one event per span, virtual-clock
 microseconds — openable at chrome://tracing or https://ui.perfetto.dev.
+When the report also carries ``kind="lineage"`` rows, each dispatch
+span's args gain the content ids of the book(s) that dispatch produced
+(``lineage_output_ids``), so clicking a span in Perfetto names the
+published artifacts it caused.
 
 Exit codes: 0 = rendered (``--strict`` turns unsound spans, sharding-lint
 flags, SLO violations, malformed latency/devtime/serving/scenario/
@@ -33,7 +40,11 @@ PartitionSpec the ledger prices as moving more bytes), and
 flight-recorder violations (an unclosed or overlapping span tree, an
 orphan trace id — a dispatch member or submitted request with no trace —
 or a ``kind="metering"`` row whose per-account costs do not sum back to
-the measured dispatch totals) into 1);
+the measured dispatch totals), and round-20 provenance violations (a
+``kind="lineage"`` edge referencing an input id no recorded edge
+produced — a dangling reference or cycle — or a ``kind="traffic"`` row
+whose verdict does not reconcile with the queue's ``kind="serving"``
+summary counters) into 1);
 2 = unusable input (missing/unreadable file, no parseable rows at all
 — empty or fully corrupt — or ``--timeline`` on a report with no
 traces). A truncated tail — a run killed mid-write — is
@@ -93,6 +104,19 @@ def _flight_mods():
     try:
         return (_load_standalone("_fmt_obs_reqtrace", base / "reqtrace.py"),
                 _load_standalone("_fmt_obs_metering", base / "metering.py"))
+    except OSError:
+        return None
+
+
+def _lineage_mod():
+    """obs/lineage.py loaded standalone (stdlib-only by contract) — the
+    round-20 provenance checkers, under the same sys.modules key as
+    tools/lineage.py so one process holds one module identity. None when
+    the package file is not next to this tool (the copied-alone render
+    box) — provenance strict checks then skip with a warning."""
+    try:
+        return _load_standalone("_fmt_obs_lineage",
+                                _REG_PATH.parent / "lineage.py")
     except OSError:
         return None
 
@@ -587,6 +611,61 @@ def _metering_table(rows) -> str | None:
                           "pad_lanes", "pad_frac", "totals"), body))
 
 
+def _lineage_table(rows) -> str | None:
+    ln = [r for r in rows if r.get("kind") == "lineage"]
+    if not ln:
+        return None
+    agg: dict = {}
+    for r in ln:
+        a = agg.setdefault(str(r.get("name", "?")),
+                           {"edges": 0, "sources": 0, "supersedes": 0,
+                            "kinds": defaultdict(int)})
+        a["edges"] += 1
+        kind = str(r.get("edge_kind", "?"))
+        a["kinds"][kind] += 1
+        if kind == "source":
+            a["sources"] += 1
+        if r.get("supersedes") is not None:
+            a["supersedes"] += 1
+    body = []
+    for name, a in sorted(agg.items()):
+        kinds = " ".join(f"{k}={v}" for k, v in sorted(a["kinds"].items())
+                         if k != "source")
+        body.append((name, a["edges"], a["sources"], kinds or "-",
+                     a["supersedes"]))
+    return ("== provenance ledger (content-addressed derivation edges; "
+            "superseding = restatement replays) ==\n"
+            + _fmt_table(("ledger", "edges", "sources", "by kind",
+                          "superseding"), body))
+
+
+def _traffic_table(rows) -> str | None:
+    tr = [r for r in rows if r.get("kind") == "traffic"]
+    if not tr:
+        return None
+    agg: dict = {}
+    for r in tr:
+        a = agg.setdefault(str(r.get("name", "?")),
+                           {"rows": 0, "arrivals": [],
+                            "verdicts": defaultdict(int)})
+        a["rows"] += 1
+        a["verdicts"][str(r.get("verdict"))] += 1
+        t = r.get("arrival_s")
+        if isinstance(t, (int, float)):
+            a["arrivals"].append(float(t))
+    body = []
+    for name, a in sorted(agg.items()):
+        verd = " ".join(f"{k}={v}" for k, v in sorted(a["verdicts"].items()))
+        span = (f"{min(a['arrivals']):.4g}..{max(a['arrivals']):.4g}"
+                if a["arrivals"] else "-")
+        body.append((name, a["rows"], span, verd or "-"))
+    return ("== recorded traffic (arrival traces; replayable via "
+            "serve.replay_traffic, verdicts must reconcile with the "
+            "serving row) ==\n"
+            + _fmt_table(("queue", "requests", "arrival_s span",
+                          "verdicts"), body))
+
+
 def _series_table(rows) -> str | None:
     se = [r for r in rows if r.get("kind") == "series"]
     if not se:
@@ -617,7 +696,8 @@ def _stage_table(rows) -> str | None:
                                        "latency", "devtime", "serving",
                                        "scenario", "online", "meta",
                                        "spec_choice", "reqtrace",
-                                       "metering", "series")]
+                                       "metering", "series", "lineage",
+                                       "traffic")]
     if not stages:
         return None
     body = []
@@ -662,7 +742,8 @@ def render(rows) -> str:
              "device_count", "mesh_shape") if meta.get(k) is not None))
     sections = [head]
     for maker in (_span_table, _latency_table, _serving_table,
-                  _reqtrace_table, _metering_table, _series_table,
+                  _reqtrace_table, _metering_table, _traffic_table,
+                  _lineage_table, _series_table,
                   _online_table, _scenario_table, _counter_table, _solver_table,
                   _numerics_table, _watchdog_table, _compile_table,
                   _comms_table, _spec_table, _memory_table, _sharding_table,
@@ -740,7 +821,12 @@ def malformed_rows(rows) -> list[str]:
     number is a broken sweep, never a publishable tail); an online
     engine row must carry non-negative integer verdict counts that SUM
     to its ingestions — the exactly-once completeness contract, judged
-    from the artifact alone."""
+    from the artifact alone; a round-20 lineage row must carry a
+    non-empty ``output_id`` content hash, an ``edge_kind`` and a list of
+    input ids (the referential checks themselves live in
+    :func:`lineage_errors`); a traffic row must carry an integer ``rid``,
+    finite arrival/deadline seconds and a verdict string — an arrival
+    trace missing any of those cannot be replayed."""
     bad = []
     for r in rows:
         kind = r.get("kind")
@@ -818,6 +904,34 @@ def malformed_rows(rows) -> list[str]:
                 bad.append(f"devtime row {r.get('name', '?')!r}/"
                            f"{r.get('stage', '?')}: neither device_s nor "
                            f"a skip/error reason")
+        elif kind == "lineage":
+            name = r.get("name", "?")
+            oid = r.get("output_id")
+            if not isinstance(oid, str) or not oid:
+                bad.append(f"lineage row {name!r} seq={r.get('seq')}: "
+                           f"missing/empty output_id {oid!r}")
+            if not isinstance(r.get("edge_kind"), str):
+                bad.append(f"lineage row {name!r} output_id={oid}: "
+                           f"missing edge_kind")
+            if not isinstance(r.get("inputs"), list):
+                bad.append(f"lineage row {name!r} output_id={oid}: "
+                           f"inputs is not a list")
+        elif kind == "traffic":
+            name = r.get("name", "?")
+            rid = r.get("rid")
+            if not isinstance(rid, int) or isinstance(rid, bool) or rid < 0:
+                bad.append(f"traffic row {name!r}: missing/invalid rid "
+                           f"{rid!r}")
+            broken = [k for k in ("arrival_s", "deadline_s")
+                      if not isinstance(r.get(k), (int, float))
+                      or isinstance(r.get(k), bool)
+                      or not math.isfinite(float(r[k]))]
+            if broken:
+                bad.append(f"traffic row {name!r} rid={rid}: non-finite "
+                           f"or missing {broken}")
+            if not isinstance(r.get("verdict"), str) or not r.get("verdict"):
+                bad.append(f"traffic row {name!r} rid={rid}: missing "
+                           f"verdict")
     return bad
 
 
@@ -846,10 +960,34 @@ def flight_errors(rows) -> list[str]:
     return errs
 
 
+def lineage_errors(rows) -> list[str]:
+    """The round-20 provenance strict checks, judged from the artifact
+    alone: every input id a ``kind="lineage"`` edge references must
+    resolve to a recorded edge, ``supersedes`` references must resolve,
+    derivation chains must be acyclic
+    (``obs.lineage.ledger_errors``), and every ``kind="traffic"`` row's
+    verdict must reconcile with the queue's ``kind="serving"`` summary
+    counters (``obs.lineage.traffic_errors``). Skips with a warning when
+    obs/lineage.py is not next to this tool (the copied-alone render
+    box)."""
+    if not any(r.get("kind") in ("lineage", "traffic") for r in rows):
+        return []
+    lin = _lineage_mod()
+    if lin is None:
+        print("warning: obs/lineage.py not found next to this tool — "
+              "provenance strict checks skipped", file=sys.stderr)
+        return []
+    return list(lin.ledger_errors(rows)) + list(lin.traffic_errors(rows))
+
+
 def write_timeline(rows, path) -> "str | None":
     """Export the report's ``kind="reqtrace"`` rows as a Chrome-trace/
     Perfetto timeline JSON (``--timeline``); returns the written path,
-    or None when the report carries no traces (nothing written)."""
+    or None when the report carries no traces (nothing written). When
+    the report also carries ``kind="lineage"`` rows, each span event
+    whose ``dispatch`` arg matches a lineage edge's recorded dispatch id
+    gains that edge's content id(s) as ``args["lineage_output_ids"]`` —
+    the span names the published books it caused."""
     import json
 
     if not any(r.get("kind") == "reqtrace" for r in rows):
@@ -860,6 +998,21 @@ def write_timeline(rows, path) -> "str | None":
                       "cannot export a timeline")
     reqtrace, _ = mods
     doc = reqtrace.chrome_trace(rows)
+    by_dispatch: dict = {}
+    for r in rows:
+        if r.get("kind") != "lineage":
+            continue
+        d = (r.get("trace") or {}).get("dispatch")
+        oid = r.get("output_id")
+        if isinstance(d, int) and isinstance(oid, str):
+            by_dispatch.setdefault(d, []).append(oid)
+    if by_dispatch:
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            oids = by_dispatch.get((ev.get("args") or {}).get("dispatch"))
+            if oids:
+                ev["args"]["lineage_output_ids"] = oids
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(doc))
@@ -883,10 +1036,11 @@ def main(argv=None) -> int:
                              "sharding-lint row is flagged, any latency "
                              "SLO is violated, any latency/devtime/"
                              "serving/scenario row is malformed (incl. "
-                             "non-finite VaR/ES), or any spec_choice "
+                             "non-finite VaR/ES), any spec_choice "
                              "row's chosen layout disagrees with the "
-                             "ledger's ranked winner — makes the "
-                             "renderer CI-able")
+                             "ledger's ranked winner, or any lineage "
+                             "edge dangles / traffic verdict fails to "
+                             "reconcile — makes the renderer CI-able")
     args = parser.parse_args(argv)
     try:
         rows = load_rows(args.jsonl)
@@ -944,6 +1098,13 @@ def main(argv=None) -> int:
                   f"(unclosed/overlapping span trees, orphan trace ids, "
                   f"or non-conserving metering rows): " + "; ".join(fl),
                   file=sys.stderr)
+            rc = 1
+        ln = lineage_errors(rows)
+        if ln:
+            print(f"strict: {len(ln)} provenance violation(s) (dangling "
+                  f"lineage references, cycles, or traffic verdicts that "
+                  f"do not reconcile with the serving row): "
+                  + "; ".join(ln), file=sys.stderr)
             rc = 1
         return rc
     return 0
