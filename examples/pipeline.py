@@ -229,7 +229,8 @@ def _serving_demo(report, say) -> None:
             max_depth=10,
             ladder=("serve_stale", "cheap_fallback", "reject_new")),
         service_model=lambda _tag, _rung: service_s,
-        queue_name="pipeline/serve/queue", flight=True, lineage=True)
+        queue_name="pipeline/serve/queue", flight=True, lineage=True,
+        sentry=True)
     c = res.counters
     say(f"  loaded: {c['submitted']} requests at 1.5x capacity -> "
         f"{c['served']} served / {c['shed_count']} shed / "
@@ -255,6 +256,35 @@ def _serving_demo(report, say) -> None:
     for line in obs_lineage.explain_lines(report.rows,
                                           name="pipeline/serve/queue"):
         say(f"    {line}")
+    # ---- the round-21 operations sentry rode the same drain
+    # (sentry=True): the default arming — zero-budget burn detectors on
+    # dispatch failures and retries — is silent on this clean drain
+    # (shedding under load is policy, not failure), and the zero lands
+    # as a gateable kind="alert" summary row. A rerun with injected
+    # dispatch faults fires an attributed alert and auto-captures an
+    # incident bundle citing the implicated traces/books/tenants.
+    assert res.sentry.alerts == []
+    say(f"  sentry: {res.sentry.evals} evaluations on the clean drain, "
+        f"0 alerts (the gateable zero)")
+    from factormodeling_tpu.resil import DispatchFaultPlan
+
+    faulty = server.serve_queued(
+        make_requests(traffic, arrivals, deadline_s=8 * service_s,
+                      tenants=[f"tenant-{i % len(configs)}"
+                               for i in range(len(traffic))]),
+        admission=AdmissionPolicy(
+            max_depth=10,
+            ladder=("serve_stale", "cheap_fallback", "reject_new")),
+        service_model=lambda _tag, _rung: service_s,
+        fault_plan=DispatchFaultPlan(seed=7, error_rate=0.4),
+        queue_name="pipeline/serve/queue-faulted", flight=True,
+        lineage=True, sentry=True)
+    inc = faulty.sentry.incidents[0]
+    say(f"  sentry under faults: {faulty.sentry.fired_signals()} fired "
+        f"-> incident {inc['incident_id']} citing "
+        f"{len(inc['alert_ids'])} alert(s), {len(inc['trace_ids'])} "
+        f"trace(s), {len(inc['output_ids'])} book(s), tenants "
+        f"{inc['tenants'][:3]}...; triage via tools/incident.py")
 
 
 def _scenario_demo(report, say) -> None:

@@ -85,6 +85,18 @@ flags):
   a deterministic function of the recorded traffic, so growth is a
   scheduling regression, not machine speed). ``max_occupancy`` drift is
   informational.
+- **alert** (operations sentry, round 21) — the sentry's alert log is a
+  deterministic function of the recorded traffic on the virtual clock,
+  so it gates in BOTH directions and stays armed under ``--no-wall``: a
+  firing ``detector(signal)`` key absent from the baseline (or a
+  firing-count/incident-count increase) is an operational regression —
+  the run now trips an alarm it didn't; a vanished sentry summary
+  scope, fired key or incident bundle is a schema regression — the
+  sentry was disarmed or the capture path stopped emitting, silently
+  un-auditing the run (re-baseline to accept an intentional fix).
+  Brand-new sentry scopes are re-baseline notes. Alert CONTENTS
+  (thresholds, values, detail strings) never gate here — completeness
+  and attribution are ``tools/incident.py --strict``'s job.
 - **bench** — bench rows are invocation-dependent (configs are selected
   per run), so presence is never gated; but a seconds-valued bench row
   present in both reports gates its value at ``wall_ratio`` — against
@@ -139,12 +151,13 @@ import sys
 from collections import defaultdict
 from pathlib import Path
 
-__all__ = ["DiffResult", "Finding", "GATE_UP", "bench_rows", "comms_rows",
-           "counter_scalars", "devtime_rows", "diff_reports",
-           "latency_rows", "lineage_rows", "load_jsonl", "memory_rows",
-           "meta_row", "metering_rows", "numerics_baseline", "online_rows",
-           "scenario_rows", "series_rows", "serving_rows",
-           "sharding_rows", "span_totals", "traffic_rows"]
+__all__ = ["DiffResult", "Finding", "GATE_UP", "alert_rows", "bench_rows",
+           "comms_rows", "counter_scalars", "devtime_rows", "diff_reports",
+           "fired_alerts", "incident_rows", "latency_rows", "lineage_rows",
+           "load_jsonl", "memory_rows", "meta_row", "metering_rows",
+           "numerics_baseline", "online_rows", "scenario_rows",
+           "series_rows", "serving_rows", "sharding_rows", "span_totals",
+           "traffic_rows"]
 
 #: absolute per-dimension growth floors of the metering gate — drift
 #: below the floor never gates, whatever the ratio says (a 2x ratio on
@@ -404,6 +417,37 @@ def traffic_rows(rows) -> dict:
     out: dict = defaultdict(int)
     for r in rows:
         if r.get("kind") == "traffic":
+            out[r.get("name", "")] += 1
+    return dict(out)
+
+
+def alert_rows(rows) -> dict:
+    """name -> last sentry SUMMARY row (kind="alert" with summary=True,
+    the round-21 operations sentry's per-scope roll-up)."""
+    return {r.get("name", ""): r for r in rows
+            if r.get("kind") == "alert" and r.get("summary")}
+
+
+def fired_alerts(rows) -> dict:
+    """name -> {"detector(signal)": firing count} over the non-summary
+    ``kind="alert"`` rows — the diff's gate key: WHICH detectors fired,
+    and how often, under the recorded traffic."""
+    out: dict = {}
+    for r in rows:
+        if r.get("kind") != "alert" or r.get("summary"):
+            continue
+        key = f"{r.get('detector', '?')}({r.get('signal', '?')})"
+        per = out.setdefault(r.get("name", ""), defaultdict(int))
+        per[key] += 1
+    return {name: dict(per) for name, per in out.items()}
+
+
+def incident_rows(rows) -> dict:
+    """name -> count of auto-captured incident bundles
+    (kind="incident")."""
+    out: dict = defaultdict(int)
+    for r in rows:
+        if r.get("kind") == "incident":
             out[r.get("name", "")] += 1
     return dict(out)
 
@@ -1037,6 +1081,64 @@ def diff_reports(base_rows, new_rows, *, wall_ratio: float = 1.5,
         findings.append(Finding(
             "traffic", name, "arrival-trace rows absent from baseline "
             "(new capture scope) — re-baseline to gate it"))
+
+    # ---- sentry alert/incident rows (round 21): the alert log is
+    # deterministic for a recorded trace on the virtual clock, so it
+    # gates in BOTH directions and stays armed under --no-wall. A NEW
+    # firing detector (or a firing-count increase) is the operational
+    # regression the sentry exists to catch; a VANISHED summary scope,
+    # fired key or incident is a schema break — the sentry was disarmed
+    # or the capture path stopped emitting, which silently un-audits the
+    # run (re-baseline to accept an intentional fix).
+    base_al, new_al = alert_rows(base_rows), alert_rows(new_rows)
+    base_fa, new_fa = fired_alerts(base_rows), fired_alerts(new_rows)
+    for name in sorted(set(base_al) - set(new_al)):
+        findings.append(Finding(
+            "alert", name, "sentry summary present in baseline, missing "
+            "in new report — the run lost its operations sentry",
+            regression=True))
+    for name in sorted(set(new_al) - set(base_al)):
+        findings.append(Finding(
+            "alert", name, "sentry summary absent from baseline (new "
+            "sentry scope) — re-baseline to gate it"))
+    for name in sorted(set(base_al) & set(new_al)):
+        b_f, n_f = base_fa.get(name, {}), new_fa.get(name, {})
+        for key in sorted(set(n_f) - set(b_f)):
+            findings.append(Finding(
+                "alert", f"{name}/{key}",
+                f"alert began firing ({n_f[key]} time(s)) under the same "
+                f"recorded traffic — not in baseline", regression=True))
+        for key in sorted(set(b_f) - set(n_f)):
+            findings.append(Finding(
+                "alert", f"{name}/{key}",
+                f"alert fired {b_f[key]} time(s) in baseline, none in "
+                f"new report — detector disarmed or log truncated "
+                f"(re-baseline to accept a fix)", regression=True))
+        for key in sorted(set(b_f) & set(n_f)):
+            if n_f[key] > b_f[key]:
+                findings.append(Finding(
+                    "alert", f"{name}/{key}",
+                    f"alert firings grew {b_f[key]} -> {n_f[key]} under "
+                    f"the same recorded traffic", regression=True))
+            elif n_f[key] != b_f[key]:
+                findings.append(Finding(
+                    "alert", f"{name}/{key}",
+                    f"alert firings {b_f[key]} -> {n_f[key]} "
+                    f"(improvement — re-baseline to gate it)"))
+    base_in, new_in = incident_rows(base_rows), incident_rows(new_rows)
+    for name in sorted(set(base_al) | set(new_al)):
+        b_i, n_i = base_in.get(name, 0), new_in.get(name, 0)
+        if n_i > b_i:
+            findings.append(Finding(
+                "alert", f"{name}/incidents",
+                f"incident bundles grew {b_i} -> {n_i} under the same "
+                f"recorded traffic", regression=True))
+        elif n_i < b_i and name in new_al:
+            findings.append(Finding(
+                "alert", f"{name}/incidents",
+                f"incident bundles {b_i} -> {n_i} — capture path stopped "
+                f"emitting (re-baseline to accept a fix)",
+                regression=True))
 
     # ---- bench rows: seconds-valued rows gate at wall_ratio against the
     # spread-aware baseline; presence never gates (configs are selected

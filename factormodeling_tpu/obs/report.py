@@ -30,7 +30,9 @@ __all__ = ["RunReport", "SCHEMA_VERSION", "SpanHandle", "active_report",
 #: 3 = PR 5: meta header + comms/memory/sharding placement-ledger rows.
 #: 4 = PR 9: latency/devtime rows (quantile sketches, SLO verdicts,
 #: device-time attribution) + bench reps/spread fields.
-SCHEMA_VERSION = 4
+#: 5 = PR 21: operations-sentry alert/incident rows (summary +
+#: firing-alert ``kind="alert"`` rows, ``kind="incident"`` bundles).
+SCHEMA_VERSION = 5
 
 _ACTIVE: "RunReport | None" = None
 

@@ -178,6 +178,23 @@ class OnlineEngine:
         ``tools/lineage.py explain`` walks the chain across the kill.
         OFF by default; ``obs.lineage`` is never imported when off (the
         elision contract).
+      sentry: the round-21 operations sentry — ``True`` builds a
+        :class:`~factormodeling_tpu.obs.sentry.Sentry` with the default
+        detectors (or pass a configured one, e.g. with zero-budget
+        reject/replay burns and CUSUM drift on ``nan_frac`` /
+        ``universe_count``); every terminal verdict then feeds one
+        observation on the ORDINAL clock (t = the ingestion count, the
+        same honest axis as the flight recorder), and a firing detector
+        auto-captures an incident bundle citing the current date as
+        tenant, the last lineage output id (when the ledger is on) and
+        the checkpoint path. ``sentry_rows()`` renders the alert log.
+        Like the ledger, sentry state RIDES the checkpoint (one
+        sorted-keys JSON string) so a resumed engine's alert log is
+        byte-equal to straight-through; incidents deliberately cite NO
+        trace ids — engine traces are per-process and a
+        checkpoint-riding incident must not dangle across a restart.
+        OFF by default; ``obs.sentry`` is never imported when off (the
+        elision contract).
     """
 
     def __init__(self, *, names, n_assets: int, template=None,
@@ -186,7 +203,7 @@ class OnlineEngine:
                  checkpoint_every: int = 1, retain_history: bool = True,
                  checkpoint_history: bool = True,
                  stats_tail: int = 8, dtype=None, progress=None,
-                 flight=None, lineage=None):
+                 flight=None, lineage=None, sentry=None):
         import jax.numpy as jnp
 
         from factormodeling_tpu.composite import prefix_group_ids
@@ -255,6 +272,12 @@ class OnlineEngine:
 
             self._lineage = (lineage if isinstance(lineage, LineageLedger)
                              else LineageLedger())
+        self._sentry = None
+        if sentry:
+            from factormodeling_tpu.obs.sentry import Sentry
+
+            self._sentry = (sentry if isinstance(sentry, Sentry)
+                            else Sentry())
 
         self._ck = None
         if checkpoint is not None:
@@ -295,7 +318,8 @@ class OnlineEngine:
         return {"entry": "online_engine", "config": self._config_tag,
                 "horizon": self.horizon,
                 "retain_history": self.retain_history,
-                **({"lineage": True} if self._lineage is not None else {})}
+                **({"lineage": True} if self._lineage is not None else {}),
+                **({"sentry": True} if self._sentry is not None else {})}
 
     def _save(self, *, force: bool = False):
         if self._ck is None:
@@ -315,6 +339,8 @@ class OnlineEngine:
         }
         if self._lineage is not None:
             state["lineage"] = self._lineage.state()
+        if self._sentry is not None:
+            state["sentry"] = self._sentry.state()
         if force:
             self._ck.save(state, meta=self._ck_meta())
         else:
@@ -345,6 +371,8 @@ class OnlineEngine:
             {d for d, _ in self._history} == set(self._applied))
         if self._lineage is not None and "lineage" in state:
             self._lineage.load_state(str(state["lineage"]))
+        if self._sentry is not None and "sentry" in state:
+            self._sentry.load_state(str(state["sentry"]))
         self._progress(f"online: resumed at date {self.last_date} "
                        f"({self.counters['applied_dates']} applied) "
                        f"from {self._ck.path}")
@@ -359,13 +387,56 @@ class OnlineEngine:
     def version(self) -> int:
         return int(np.asarray(self._state[0].version))
 
-    def _reject(self, date: int, reason: str) -> OnlineVerdict:
+    def _reject(self, date: int, reason: str, h=None) -> OnlineVerdict:
         self.counters["rejected_dates"] += 1
         self.rejected_reasons[reason] = \
             self.rejected_reasons.get(reason, 0) + 1
+        self._sentry_observe(date, h)
         self._record()
         return OnlineVerdict(date=int(date), status="rejected",
                              reason=reason)
+
+    def _sentry_observe(self, date: int, h) -> None:
+        """One sentry observation per terminal verdict, on the ordinal
+        clock (t = ingestion count). Gauges come from the CURRENT slice
+        with the same math as the admission guards, so a drift detector
+        watches exactly what ``_guard_reason`` would have thresholded —
+        omitted for malformed slices, whose shapes cannot be trusted."""
+        if self._sentry is None:
+            return
+        c = self.counters
+        gauges: dict = {}
+        if h is not None and self._slice_reason(h) is None:
+            fac = h["factors"]
+            if "universe" in h:
+                uni = h["universe"][None]
+                denom = max(int(uni.sum()) * fac.shape[0], 1)
+                nans = int((np.isnan(fac) & uni).sum())
+            else:
+                denom = max(fac.size, 1)
+                nans = int(np.isnan(fac).sum())
+            gauges["nan_frac"] = nans / denom
+            gauges["universe_count"] = float(
+                int(h["universe"].sum()) if "universe" in h
+                else h["returns"].shape[-1])
+        out_ids: list = []
+        if self._lineage is not None:
+            last = self._lineage.last_edge()
+            if last is not None:
+                out_ids.append(last["output_id"])
+        self._sentry.observe(
+            t=float(c["ingested_dates"]),
+            counters={"ingested": c["ingested_dates"],
+                      "applied": c["applied_dates"],
+                      "replayed": c["replayed_dates"],
+                      "rejected": c["rejected_dates"],
+                      "replay_applied": c["replay_applied_dates"],
+                      "fallbacks": c["full_recompute_fallbacks"]},
+            gauges=gauges,
+            context={"trace_ids": [], "output_ids": out_ids,
+                     "tenants": [str(int(date))],
+                     "checkpoint": (str(self._ck.path)
+                                    if self._ck is not None else None)})
 
     def _guard_reason(self, h: dict):
         g = self.guards
@@ -513,6 +584,16 @@ class OnlineEngine:
         return self._lineage.rows(name if name is not None
                                   else f"online/engine/{self._config_tag}")
 
+    def sentry_rows(self, name: str | None = None) -> list:
+        """The sentry's ``kind="alert"``/``kind="incident"`` rows (empty
+        with the sentry off) — append them to a report next to the
+        ``kind="online"`` rows; ``tools/incident.py`` renders and
+        verifies them."""
+        if self._sentry is None:
+            return []
+        return self._sentry.rows(name if name is not None
+                                 else f"online/engine/{self._config_tag}")
+
     def _ingest_inner(self, date: int, date_slice: DateSlice,
                       restate: bool = False) -> OnlineVerdict:
         date = int(date)
@@ -520,18 +601,19 @@ class OnlineEngine:
         h = _host_slice(date_slice)
         reason = self._slice_reason(h)
         if reason is not None:
-            return self._reject(date, reason)
+            return self._reject(date, reason, h)
         if restate:
             return self._ingest_restatement(date, h)
         if self._applied and date <= self._applied[-1]:
             return self._reject(
                 date, "duplicate" if date in self._applied_set
-                else "out_of_order")
+                else "out_of_order", h)
         reason = self._guard_reason(h)
         if reason is not None:
-            return self._reject(date, reason)
+            return self._reject(date, reason, h)
         outs = self._apply_one(date, h, replaying=False)
         self.counters["applied_dates"] += 1
+        self._sentry_observe(date, h)
         self._save()
         self._record()
         self._die_hook(date)
@@ -540,7 +622,7 @@ class OnlineEngine:
 
     def _ingest_restatement(self, date: int, h: dict) -> OnlineVerdict:
         if date not in self._applied_set:
-            return self._reject(date, "restate_unknown")
+            return self._reject(date, "restate_unknown", h)
         # a corrected slice passes the SAME admission guards as a fresh
         # one: a guarded engine must not fold a NaN-storm or collapsed
         # restatement into its rolling state just because the date id is
@@ -548,7 +630,7 @@ class OnlineEngine:
         # silently applied" — the module contract)
         reason = self._guard_reason(h)
         if reason is not None:
-            return self._reject(date, reason)
+            return self._reject(date, reason, h)
         ring_dates = [d for d, _ in self._snapshots]
         if date in ring_dates:
             verdict = self._rollback_replay(date, h)
@@ -564,8 +646,9 @@ class OnlineEngine:
             # whose pre-resume prefix is gone, and a genesis replay over
             # that truncated prefix would silently diverge) — explicit
             # rejection, never a silent partial replay
-            return self._reject(date, "restate_beyond_horizon")
+            return self._reject(date, "restate_beyond_horizon", h)
         self.counters["replayed_dates"] += 1
+        self._sentry_observe(date, h)
         self._save(force=True)
         self._record()
         self._die_hook(date)

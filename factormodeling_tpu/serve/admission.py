@@ -56,13 +56,24 @@ class AdmissionPolicy:
     bench's overload-baseline configuration, not a production one).
     ``p99_budget_s=None`` disables the latency trigger. ``ladder`` is
     consulted in order for each overloaded arrival; an empty ladder (or
-    one no step of which applies) sheds."""
+    one no step of which applies) sheds.
+
+    ``on_alert`` (round 21) is the OBSERVE-ONLY sentry hook: when the
+    queue runs with the operations sentry on, every firing alert dict is
+    passed to it at the dispatch boundary that fired it. Default None —
+    inert; no scheduling decision reads its result in this round (the
+    stepping stone to risk-driven load-shedding, ROADMAP item 4).
+    Excluded from ``repr``/comparison: the checkpoint meta guard keys on
+    ``repr(policy)``, and a callback must not invalidate snapshots whose
+    scheduling-relevant policy is unchanged."""
 
     max_depth: "int | None" = 64
     p99_budget_s: "float | None" = None
     ladder: tuple = (REJECT_NEW,)
     cheap_method: str = "equal"
     stale_cap: int = 256
+    on_alert: object = dataclasses.field(default=None, repr=False,
+                                         compare=False)
 
     def __post_init__(self):
         if self.max_depth is not None and int(self.max_depth) < 1:
@@ -79,6 +90,9 @@ class AdmissionPolicy:
                              f"{LADDER_STEPS}")
         if int(self.stale_cap) < 1:
             raise ValueError(f"stale_cap must be >= 1, got {self.stale_cap}")
+        if self.on_alert is not None and not callable(self.on_alert):
+            raise ValueError(f"on_alert must be callable or None, got "
+                             f"{self.on_alert!r}")
 
     def overloaded(self, *, depth: int, served_p99_s) -> "str | None":
         """The overload reason at this instant, or None. The p99 trigger

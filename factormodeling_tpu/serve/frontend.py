@@ -656,8 +656,8 @@ class TenantServer:
                                                name=name,
                                                expected_signatures=1)
 
-    def advance_all(self, date_slice, *, date=None,
-                    meter=None) -> "list[TenantAdvance]":
+    def advance_all(self, date_slice, *, date=None, meter=None,
+                    series=None) -> "list[TenantAdvance]":
         """Advance EVERY tenant of every bucket by one arriving date —
         one vmapped dispatch per bucket over the stacked state pytrees
         (:meth:`online_begin` docs). Returns one :class:`TenantAdvance`
@@ -671,7 +671,18 @@ class TenantServer:
         lanes billed to ``overhead/pad``, the same honesty rule as the
         queue); ``date`` labels the account (defaults to the session's
         advance ordinal). With ``meter=None`` (the default) no wall is
-        measured and no fence is added — the advance path is untouched."""
+        measured and no fence is added — the advance path is untouched.
+
+        ``series`` (round 21): a
+        :class:`~factormodeling_tpu.obs.reqtrace.HealthSeries` — each
+        call then appends ONE sample at the tick boundary (``t`` = the
+        ``date`` label on the virtual/ordinal axis): depth = the open
+        session count, occupancy = the mean real-lane fraction across
+        sessions, shed rate = 0 (the online path has no admission
+        ladder). Before this round only the queue sampled the ring, so
+        an online-only run reported an empty health series; the
+        exact-maxima contract (max depth/occupancy tracked outside the
+        ring cap) is unchanged."""
         if not getattr(self, "_online", None):
             raise RuntimeError("advance_all before online_begin — open an "
                                "online session first")
@@ -715,6 +726,11 @@ class TenantServer:
                     index=i, config=self._online_configs[i],
                     output=jax.tree_util.tree_map(
                         lambda a, lane=lane: a[lane], outs))
+        if series is not None:
+            occ = [len(s["members"]) / s["rung"]
+                   for s in self._online.values()]
+            series.sample(t=float(date), depth=len(self._online),
+                          occupancy=sum(occ) / len(occ), shed_rate=0.0)
         return results
 
     # -------------------------------------------------------------- stats

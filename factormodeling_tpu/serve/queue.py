@@ -305,6 +305,7 @@ class QueueResult(NamedTuple):
     traffic: list = None   # kind="traffic" arrival-trace rows (complete
     #                        drains only — the replay_traffic input)
     lineage: object = None  # the LineageLedger when provenance ran
+    sentry: object = None   # the Sentry when the operations sentry ran
 
     def by_rid(self) -> dict:
         return {v["rid"]: v for v in self.verdicts}
@@ -421,7 +422,7 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                retry_backoff_s: float = 0.001, flush_headroom_s: float = 0.0,
                clock=None, seed_latency=None, checkpoint_path=None,
                checkpoint_every: int = 1, queue_name: str = "serve/queue",
-               flight=None, lineage=None,
+               flight=None, lineage=None, sentry=None,
                _stop_after_dispatches=None) -> QueueResult:
     """Drain ``requests`` through ``server`` under the traffic layer
     (module docs). Prefer calling it as
@@ -460,6 +461,20 @@ def run_queued(server, requests, *, admission=None, service_model=None,
     ``obs.lineage`` (subprocess-pinned), ledger state rides the
     checkpoint so a resumed ledger is byte-equal to straight-through,
     and the ledger returns on ``QueueResult.lineage``.
+    ``sentry``: the round-21 operations sentry — ``True`` builds a
+    default :class:`~factormodeling_tpu.obs.sentry.Sentry` (zero-budget
+    burn detectors over dispatch failures and retries; pass a configured
+    one to arm drift/budget detectors); it then evaluates at EVERY
+    dispatch boundary on the virtual clock, fires typed alerts
+    (observe-only: ``admission.on_alert`` sees each one, scheduling is
+    untouched), and auto-captures incident bundles citing the chunk's
+    trace ids, lineage output ids, tenants and the checkpoint reference.
+    Same elision contract as ``flight``/``lineage``: OFF by default,
+    ``sentry=None`` never imports ``obs.sentry`` (subprocess-pinned),
+    sentry state rides the checkpoint so a resumed run's alert log is
+    byte-equal to straight-through, and the ``kind="alert"`` /
+    ``kind="incident"`` rows land on the active report only on a
+    complete drain. The sentry returns on ``QueueResult.sentry``.
     Every COMPLETE drain additionally records ``kind="traffic"``
     arrival-trace rows (rid, tenant, exact arrival/deadline seconds,
     static key, final verdict) — unconditionally, they are plain host
@@ -492,6 +507,13 @@ def run_queued(server, requests, *, admission=None, service_model=None,
 
         ledger = (lineage if isinstance(lineage, LineageLedger)
                   else LineageLedger())
+    # the operations sentry: same opt-in shape — sentry=None (the
+    # default) never imports obs.sentry (the elision pin)
+    sn = None
+    if sentry:
+        from factormodeling_tpu.obs.sentry import Sentry
+
+        sn = sentry if isinstance(sentry, Sentry) else Sentry()
     ladder = server.pad_ladder
     top = ladder[-1]
     n = len(requests)
@@ -542,7 +564,8 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                    "flush_headroom_s": float(flush_headroom_s),
                    "fault_plan": repr(fault_plan),
                    **({"flight": True} if kit is not None else {}),
-                   **({"lineage": True} if ledger is not None else {})}
+                   **({"lineage": True} if ledger is not None else {}),
+                   **({"sentry": True} if sn is not None else {})}
         # recorder ON joins the guard (resuming a flight-on snapshot
         # without the kit — or vice versa — would silently drop the
         # trace log's prefix), but flight-OFF runs deliberately omit
@@ -571,6 +594,8 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                 kit.load_state(str(state["flight"]))
             if ledger is not None and "lineage" in state:
                 ledger.load_state(str(state["lineage"]))
+            if sn is not None and "sentry" in state:
+                sn.load_state(str(state["sentry"]))
             for skey, items in state["pending"]:
                 # bucket keys restore in snapshot order, EMPTY buckets
                 # included — dispatch-order determinism across a resume
@@ -822,6 +847,7 @@ def run_queued(server, requests, *, admission=None, service_model=None,
         # link the flight recorder exists for)
         d_sids: dict = {}
         attempt_log: list = []
+        dispatch_out_ids: list = []  # lineage edge ids (sentry incidents)
         if kit is not None:
             t_form = clock.now_s
             pad_f = (rung - len(chunk)) / rung
@@ -894,6 +920,7 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                         detail=f"dispatch failed after retries: {e}")
             _remove_from_pending(skey, chunk)
             _sample_health(len(chunk), rung)
+            _observe_sentry(chunk, rung, [])
             _finish_dispatch(skey, rung, None, downgraded)
             return
 
@@ -943,7 +970,7 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                 # one content-addressed edge per delivered lane:
                 # book-fingerprint <- {panels, config}, stamped with the
                 # executable identity and the reqtrace dispatch id
-                ledger.edge(
+                edge_id = ledger.edge(
                     _ckpt.fingerprint(*([host_books[lane]]
                                         if host_books is not None
                                         else _book_leaves(out_lane))),
@@ -955,12 +982,15 @@ def run_queued(server, requests, *, admission=None, service_model=None,
                           "rung": int(rung), "mesh": lin_mesh},
                     trace={"dispatch": int(dispatch_idx)},
                     rid=int(p.rid), tenant=r.label)
+                if sn is not None:
+                    dispatch_out_ids.append(edge_id)
         _remove_from_pending(skey, chunk)
         record_stage("serve/queue/dispatch", kind="stage",
                      entry_point=name, rung=rung, configs=len(chunk),
                      padded_lanes=pad, downgraded=bool(downgraded),
                      virtual_t_s=_round(t_done))
         _sample_health(len(chunk), rung)
+        _observe_sentry(chunk, rung, dispatch_out_ids)
         _finish_dispatch(skey, rung, name, downgraded)
 
     def _sample_health(chunk_len: int, rung: int) -> None:
@@ -973,6 +1003,40 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             occupancy=chunk_len / rung,
             shed_rate=counters["shed_count"] / max(1, arr_idx),
             served_p99_s=served_p99())
+
+    def _observe_sentry(chunk, rung, out_ids) -> None:
+        # the sentry evaluation at the dispatch boundary — BEFORE the
+        # checkpoint in _finish_dispatch, so the alert log rides the
+        # snapshot (byte-equal across a kill/resume)
+        if sn is None:
+            return
+        fired = sn.observe(
+            t=clock.now_s,
+            counters={"submitted": arr_idx,
+                      "served": counters["served"],
+                      "failed": counters["failed_count"],
+                      "retries": counters["retry_count"],
+                      "shed": counters["shed_count"],
+                      "deadline_miss": counters["deadline_miss_count"],
+                      "dispatches": counters["dispatches"]},
+            gauges={"depth": depth(),
+                    "occupancy": len(chunk) / rung,
+                    "pad_fraction": (rung - len(chunk)) / rung,
+                    "served_p99_s": served_p99()},
+            accounts=kit.meter.accounts if kit is not None else None,
+            context={
+                "trace_ids": ([str(p.rid) for p in chunk]
+                              if kit is not None else []),
+                "output_ids": out_ids,
+                "tenants": [req_by_rid[p.rid].label for p in chunk],
+                "checkpoint": (f"{checkpoint_path}@{dispatch_idx}"
+                               if ck is not None else None)})
+        if fired and admission.on_alert is not None:
+            # observe-only: the hook SEES each alert (the stepping stone
+            # to risk-driven shedding) but no scheduling decision in
+            # this round reads its result
+            for alert in fired:
+                admission.on_alert(alert)
 
     def _finish_dispatch(skey, rung, name, downgraded) -> None:
         nonlocal dispatch_idx
@@ -1024,6 +1088,10 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             # same seam, same contract: the resumed ledger must be
             # byte-equal to a straight-through run's
             state["lineage"] = ledger.state()
+        if sn is not None:
+            # and once more for the sentry: a resumed run's alert log
+            # must be byte-equal to a straight-through run's
+            state["sentry"] = sn.state()
         return state
 
     # ------------------------------------------------------ the event loop
@@ -1103,9 +1171,14 @@ def run_queued(server, requests, *, admission=None, service_model=None,
             # lineage rows follow the same complete-drain rule: a partial
             # ledger is exactly the dangling shape --strict rejects
             rep.rows.extend(ledger.rows(queue_name))
+        if rep is not None and sn is not None:
+            # alert/incident rows too: an incident citing traces the
+            # report does not (yet) contain is exactly the dangling
+            # shape --strict rejects
+            rep.rows.extend(sn.rows(queue_name))
     return QueueResult(verdicts=verdict_log, outputs=outputs,
                        counters=row, clock_s=clock.now_s, flight=kit,
-                       traffic=traffic, lineage=ledger)
+                       traffic=traffic, lineage=ledger, sentry=sn)
 
 
 # ---------------------------------------------------- recorded-traffic replay

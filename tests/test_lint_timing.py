@@ -63,6 +63,15 @@ def test_default_targets_cover_examples_and_obs_layer():
     # content addresses are pure functions of bytes, not of time)
     assert {p.parent.name for p in targets
             if p.name == "lineage.py"} == {"obs", "tools"}
+    # round 21: the operations sentry — the detectors run on the
+    # caller's EXPLICIT clock (virtual seconds / ordinal ticks), so an
+    # ambient perf_counter in obs/sentry.py would re-couple the alert
+    # log to host jitter and break its byte-equal determinism claim;
+    # the incident CLI rides the tools glob
+    assert "sentry.py" in {p.name for p in targets
+                           if p.parent.name == "obs"}
+    assert "incident.py" in {p.name for p in targets
+                             if p.parent.name == "tools"}
 
 
 def test_default_targets_cover_the_pallas_kernel_modules():

@@ -1,0 +1,686 @@
+"""The round-21 operations sentry (``obs/sentry.py``, docs §27), its
+producing layers, and the tooling that audits its artifact.
+
+Contract pinned here:
+
+- **detector math**: the burn-rate window algebra over cumulative
+  counter snapshots (both windows must burn; zero budget fires on the
+  first bad event; transition latching makes a sustained excursion ONE
+  alert), the gauge drift detectors (CUSUM step, Page-Hinkley ramp,
+  EWMA-band excursion — warmup never arms, fire resets/re-arms), and
+  the per-tenant budget watch (each breach fires once);
+- **determinism**: the same signal sequence produces a byte-equal
+  ``state()`` — the property every other pin here rides;
+- **queue integration**: a clean drain fires ZERO alerts (the default
+  arming cannot false-positive on shedding), a faulty drain fires
+  attributed alerts whose incident bundles cite trace/output ids that
+  resolve within the same report (``sentry_errors`` empty), and
+  ``AdmissionPolicy.on_alert`` observes every alert without touching
+  the verdict log;
+- **kill/resume**: sentry state rides the queue checkpoint — both the
+  in-process stop seam and a real SIGKILL'd subprocess resume to an
+  alert log byte-equal to an uninterrupted run's;
+- **structural elision**: the default queue path (``sentry=None``)
+  serves bit-identically with ``obs.sentry`` made unimportable;
+- **tick-boundary sampling**: ``advance_all(series=...)`` appends one
+  health sample per online tick with exact maxima;
+- **gating**: the regression differ flags a NEW firing detector, a
+  vanished one, and a vanished scope — in both directions, armed under
+  ``--no-wall`` — and ``tools/incident.py`` renders the triage story
+  and ``--strict``-rejects a dangling incident reference.
+
+Named ``test_sentry`` — it collects after ``tests/test_serve.py`` and
+reuses the serve suite's market seed, the same executable-cache
+courtesy ``tests/test_serve_lineage.py`` documents.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from factormodeling_tpu import obs
+from factormodeling_tpu.obs import regression
+from factormodeling_tpu.obs import sentry as obs_sentry
+from factormodeling_tpu.obs.reqtrace import HealthSeries
+from factormodeling_tpu.obs.sentry import (
+    BudgetWatch,
+    BurnRateDetector,
+    CusumDetector,
+    EwmaBandDetector,
+    PageHinkley,
+    Sentry,
+)
+from factormodeling_tpu.resil import DispatchFaultPlan
+from factormodeling_tpu.serve import TenantConfig, TenantServer
+from factormodeling_tpu.serve.admission import AdmissionPolicy
+from factormodeling_tpu.serve.queue import bursty_arrivals, make_requests
+
+REPO = Path(__file__).resolve().parent.parent
+INCIDENT_CLI = str(REPO / "tools" / "incident.py")
+TRACE_CLI = str(REPO / "tools" / "trace_report.py")
+
+# WINDOW=7 keeps this module's static_key (and therefore its
+# serve/bucket/* compile-stats entries) DISJOINT from the window=6
+# suites (test_reqtrace/test_serve_queue): re-serving a bucket another
+# module compiled recompiles it if the cap-16 streaming LRU evicted it
+# in between, and the cumulative ``retraced`` flag would then trip
+# test_serve.py's global no-retrace assertion.
+F, D, N, WINDOW = 5, 30, 8, 7
+NAMES = ("fam0_f0_flx", "fam0_f1_eq", "fam1_f2_flx", "fam1_f3_long",
+         "fam2_f4_flx")
+LADDER = (1, 4, 8)
+SERVICE = 0.05
+
+
+def make_market(rng, *, d=D, n=N, f=F):
+    factors = rng.normal(size=(f, d, n))
+    factors[rng.uniform(size=factors.shape) < 0.05] = np.nan
+    return dict(
+        factors=factors,
+        returns=rng.normal(scale=0.02, size=(d, n)),
+        factor_ret=rng.normal(scale=0.01, size=(d, f)),
+        cap_flag=rng.integers(1, 4, size=(d, n)).astype(float),
+        investability=np.ones((d, n)),
+        universe=rng.uniform(size=(d, n)) > 0.05,
+    )
+
+
+@pytest.fixture(scope="module")
+def market():
+    # same seed as tests/test_serve_queue.py (familiar numbers), but the
+    # WINDOW above keeps the compiled buckets module-private
+    return make_market(np.random.default_rng(20260804))
+
+
+def mk_server(market, **kw):
+    kw.setdefault("pad_ladder", LADDER)
+    return TenantServer(names=NAMES, **market, **kw)
+
+
+def equal_cfg(i=0, **kw):
+    kw.setdefault("method", "equal")
+    kw.setdefault("window", WINDOW)
+    kw.setdefault("icir_threshold", -1.0)
+    kw.setdefault("top_k", 1 + i % F)
+    return TenantConfig(**kw)
+
+
+def const_service(_tag, _rung):
+    return SERVICE
+
+
+def run_cli(*argv):
+    return subprocess.run([sys.executable, *argv], capture_output=True,
+                          text=True, timeout=120)
+
+
+def no_ckpt_state(sn):
+    """Sentry state with incident checkpoint refs nulled: the ref names
+    the snapshot a responder would resume FROM, so it exists only on the
+    checkpointed side of a kill/resume differential — the one field a
+    straight-through (no-checkpoint) run legitimately cannot carry. The
+    alert log itself is compared byte-equal, un-normalized."""
+    doc = json.loads(sn.state())
+    for i in doc["incidents"]:
+        i["checkpoint"] = None
+    return json.dumps(doc, sort_keys=True)
+
+
+# -------------------------------------------------- burn-rate detectors
+
+
+def test_burn_rate_window_algebra():
+    """Both windows must burn: a blip that clears before the slow window
+    fills never fires; a sustained bad rate fires ONCE (the latch) and
+    re-arms after the windows age the excursion out."""
+    det = BurnRateDetector("err", bad="bad", total="total", budget=0.25,
+                           threshold=1.0, fast_window_s=2.0,
+                           slow_window_s=8.0)
+    # one bad event at t=1 inside a long clean stream: fast burn spikes
+    # (1/2 over budget 0.25 = 2x) but the slow window holds the rate at
+    # 1/2 too... both exceed -> the SLOW window is what suppresses once
+    # enough clean traffic dilutes it
+    assert det.observe(0.0, {"bad": 0, "total": 0}, {}, None) is None
+    fired = det.observe(1.0, {"bad": 1, "total": 2}, {}, None)
+    assert fired is not None and fired["signal"] == "err"
+    assert fired["window"] == "2s/8s" and fired["threshold"] == 1.0
+    # sustained alarm: NOT a second alert (fire-on-transition)
+    assert det.observe(1.5, {"bad": 2, "total": 3}, {}, None) is None
+    # clean traffic dilutes both windows below threshold -> re-arms...
+    for t in range(2, 12):
+        assert det.observe(float(t),
+                           {"bad": 2, "total": 3 + 20 * (t - 1)},
+                           {}, None) is None
+    # ...and a SUSTAINED fresh burst fires again once the slow window
+    # fills with the new bad rate (a single-tick blip cannot)
+    refired = [det.observe(float(t),
+                           {"bad": 2 + 30 * (t - 11),
+                            "total": 203 + 31 * (t - 11)}, {}, None)
+               for t in range(12, 24)]
+    assert sum(f is not None for f in refired) == 1
+
+
+def test_burn_rate_slow_window_suppresses_blips():
+    det = BurnRateDetector("err", bad="bad", total="total", budget=0.25,
+                           threshold=1.0, fast_window_s=1.0,
+                           slow_window_s=10.0)
+    # a long clean history, then one bad event: the fast window burns
+    # (1/1 / 0.25 = 4x) but the slow window's rate 1/101 stays under
+    # budget -> no alert
+    det.observe(0.0, {"bad": 0, "total": 0}, {}, None)
+    det.observe(5.0, {"bad": 0, "total": 100}, {}, None)
+    assert det.observe(6.0, {"bad": 1, "total": 101}, {}, None) is None
+
+
+def test_burn_rate_zero_budget_fires_on_first_bad_event():
+    det = BurnRateDetector("fail", bad="failed", total="submitted",
+                           budget=0.0)
+    assert det.observe(0.0, {"failed": 0, "submitted": 4}, {}, None) is None
+    fired = det.observe(0.1, {"failed": 1, "submitted": 5}, {}, None)
+    assert fired and "zero-budget" in fired["detail"]
+    assert fired["budget"] == 0.0
+    # missing counter keys skip the evaluation entirely (one detector
+    # set serves queue and engine alike)
+    assert det.observe(0.2, {"other": 1}, {}, None) is None
+
+
+def test_burn_rate_validation():
+    kw = dict(bad="b", total="t", budget=0.1)
+    with pytest.raises(ValueError, match="budget"):
+        BurnRateDetector("s", bad="b", total="t", budget=-1.0)
+    with pytest.raises(ValueError, match="threshold"):
+        BurnRateDetector("s", threshold=0.0, **kw)
+    with pytest.raises(ValueError, match="fast_window_s"):
+        BurnRateDetector("s", fast_window_s=3.0, slow_window_s=1.0, **kw)
+
+
+# ------------------------------------------------------ drift detectors
+
+
+def test_cusum_detects_step_and_resets():
+    det = CusumDetector("g", k=0.5, h=5.0, warmup=5)
+    # warmup + a stable stretch DEFINE normal without arming
+    for t in range(12):
+        assert det.observe(float(t), {}, {"g": 1.0 + 0.01 * (t % 2)},
+                           None) is None
+    # a step change accumulates and fires an upward shift
+    fired = None
+    for t in range(12, 30):
+        fired = det.observe(float(t), {}, {"g": 2.0}, None)
+        if fired:
+            break
+    assert fired and "upward" in fired["detail"]
+    assert fired["window"] == "ewma" and fired["threshold"] == 5.0
+    # the firing side reset: the accumulator starts over
+    assert det.s_hi == 0.0
+
+
+def test_page_hinkley_detects_ramp():
+    det = PageHinkley("g", delta=0.005, lam=2.0, warmup=5)
+    for t in range(8):
+        assert det.observe(float(t), {}, {"g": 0.0}, None) is None
+    fired = None
+    for t in range(8, 40):
+        fired = det.observe(float(t), {}, {"g": 0.05 * (t - 8)}, None)
+        if fired:
+            break
+    assert fired and "upward drift" in fired["detail"]
+
+
+def test_ewma_band_latches_one_alert_per_excursion():
+    det = EwmaBandDetector("g", nsig=4.0, warmup=5)
+    for t in range(10):
+        assert det.observe(float(t), {}, {"g": 1.0 + 0.01 * (t % 3)},
+                           None) is None
+    fired = det.observe(10.0, {}, {"g": 50.0}, None)
+    assert fired and "left the ewma band" in fired["detail"]
+    # still outside the band: latched, no second alert
+    assert det.observe(11.0, {}, {"g": 50.0}, None) is None
+    # gauge detectors skip missing and non-finite samples
+    assert det.observe(12.0, {}, {}, None) is None
+    assert det.observe(13.0, {}, {"g": float("nan")}, None) is None
+
+
+def test_budget_watch_fires_once_per_breached_pair():
+    det = BudgetWatch({"t0": {"cost_s": 1.0}})
+    assert det.observe(0.0, {}, {}, {"t0": {"cost_s": 0.5}}) is None
+    fired = det.observe(1.0, {}, {}, {"t0": {"cost_s": 1.5}})
+    assert fired and fired["tenant"] == "t0" and fired["window"] == "run"
+    # the account only grows: the breach stays latched
+    assert det.observe(2.0, {}, {}, {"t0": {"cost_s": 9.0}}) is None
+    with pytest.raises(ValueError, match="positive"):
+        BudgetWatch({"t0": {"cost_s": 0.0}})
+
+
+# ----------------------------------------------------- the sentry object
+
+
+def _feed(sn):
+    """One deterministic faulty signal sequence."""
+    for t in range(8):
+        sn.observe(t=float(t),
+                   counters={"failed": max(0, t - 4), "retries": t // 3,
+                             "submitted": 2 * t + 1},
+                   gauges={"depth": float(t % 3)},
+                   context={"trace_ids": [], "output_ids": [],
+                            "tenants": [f"t{t % 2}"], "checkpoint": None})
+    return sn
+
+
+def test_sentry_state_roundtrip_and_determinism():
+    a, b = _feed(Sentry()), _feed(Sentry())
+    assert a.alerts and a.fired_signals() == ["retry_rate", "failure_rate"]
+    # determinism: the same sequence is byte-equal state
+    assert a.state() == b.state()
+    # round-trip through the checkpoint seam restores byte-equal
+    c = Sentry()
+    c.load_state(a.state())
+    assert c.state() == a.state()
+    # resuming with a different detector set is a refused snapshot
+    with pytest.raises(ValueError, match="detector"):
+        Sentry(detectors=[CusumDetector("g")]).load_state(a.state())
+
+
+def test_sentry_rows_pass_their_own_strict_checks():
+    sn = _feed(Sentry())
+    rows = sn.rows("unit/q")
+    summary = rows[0]
+    assert summary["summary"] and summary["alerts_fired"] == len(sn.alerts)
+    assert summary["incidents"] == len(sn.incidents) >= 1
+    assert obs_sentry.sentry_errors(rows) == []
+    # incident bundles cite the alerts that fired them
+    inc = [r for r in rows if r["kind"] == "incident"]
+    cited = {a for r in inc for a in r["alert_ids"]}
+    assert cited <= {r["alert_id"] for r in rows
+                     if r["kind"] == "alert" and not r.get("summary")}
+
+
+def test_alert_errors_catch_truncation_and_missing_meta():
+    rows = _feed(Sentry()).rows("unit/q")
+    # a dropped firing row breaks the summary count
+    errs = obs_sentry.alert_errors([r for r in rows
+                                    if r.get("alert_id") != "a0"])
+    assert any("truncated" in e for e in errs)
+    # a firing row without its attribution is named field-by-field
+    bad = [dict(r) for r in rows]
+    bad[1].pop("signal")
+    assert any("missing 'signal'" in e for e in obs_sentry.alert_errors(bad))
+
+
+def test_incident_errors_catch_dangling_references():
+    rows = _feed(Sentry()).rows("unit/q")
+    bad = [dict(r) for r in rows]
+    for r in bad:
+        if r["kind"] == "incident":
+            r["alert_ids"] = ["a99"]
+            r["trace_ids"] = ["7"]
+            r["output_ids"] = ["f" * 16]
+            break
+    errs = obs_sentry.incident_errors(bad)
+    assert any("dangling alert id" in e for e in errs)
+    assert any("dangling trace id" in e for e in errs)
+    assert any("dangling output id" in e for e in errs)
+    # the same refs RESOLVE once the evidence rows are present
+    evidence = [{"kind": "reqtrace", "name": "unit/q", "trace_id": "7"},
+                {"kind": "lineage", "name": "unit/q",
+                 "output_id": "f" * 16}]
+    errs = obs_sentry.incident_errors(bad + evidence)
+    assert not any("trace id" in e or "output id" in e for e in errs)
+
+
+# ------------------------------------------------------ queue integration
+
+
+@pytest.fixture(scope="module")
+def faulty_report(market, tmp_path_factory):
+    """ONE flight+lineage+sentry faulty drain shared by the tool tests:
+    its report JSONL and the QueueResult it came from."""
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(12)]
+    arrivals = bursty_arrivals(12, rate_hz=1.2 * LADDER[-1] / SERVICE,
+                               burst=5, seed=11)
+    rep = obs.RunReport("sentry-report")
+    with rep.activate():
+        res = server.serve_queued(
+            make_requests(cfgs, arrivals, deadline_s=0.7),
+            admission=AdmissionPolicy(max_depth=10),
+            service_model=const_service,
+            fault_plan=DispatchFaultPlan(seed=2, error_rate=0.3),
+            retries=2, flight=True, lineage=True, sentry=True)
+    path = tmp_path_factory.mktemp("sentry") / "report.jsonl"
+    rep.write_jsonl(path)
+    return path, res
+
+
+def test_clean_drain_fires_zero_alerts(market):
+    """The default arming's no-false-positive pin: a drain that sheds
+    under a tight depth bound (but never fails or retries) fires ZERO
+    alerts — and the zero is itself a gateable summary row."""
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(10)]
+    arrivals = bursty_arrivals(10, rate_hz=2 * LADDER[-1] / SERVICE,
+                               burst=8, seed=3)
+    rep = obs.RunReport("sentry-clean")
+    with rep.activate():
+        res = server.serve_queued(
+            make_requests(cfgs, arrivals, deadline_s=0.7),
+            admission=AdmissionPolicy(max_depth=3),
+            service_model=const_service, sentry=True)
+    assert res.counters["shed_count"] > 0  # genuinely overloaded
+    assert res.sentry.alerts == [] and res.sentry.incidents == []
+    summaries = [r for r in rep.rows if r.get("kind") == "alert"]
+    assert len(summaries) == 1 and summaries[0]["alerts_fired"] == 0
+    assert summaries[0]["evals"] == res.counters["dispatches"]
+
+
+def test_faulty_drain_fires_attributed_alerts_with_incidents(
+        faulty_report):
+    path, res = faulty_report
+    assert res.counters["retry_count"] > 0
+    fired = set(res.sentry.fired_signals())
+    assert fired and fired <= {"retry_rate", "failure_rate"}
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    # the bundles' cited trace/output ids resolve WITHIN the same report
+    assert obs_sentry.sentry_errors(rows) == []
+    inc = [r for r in rows if r.get("kind") == "incident"]
+    assert inc and all(r["alert_ids"] for r in inc)
+    assert any(r["trace_ids"] for r in inc)  # flight was on
+    assert any(r["output_ids"] for r in inc)  # lineage was on
+    assert all(r["checkpoint"] is None for r in inc)  # no checkpoint_path
+
+
+def test_on_alert_hook_observes_without_scheduling_effect(market):
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(12)]
+    arrivals = bursty_arrivals(12, rate_hz=1.2 * LADDER[-1] / SERVICE,
+                               burst=5, seed=11)
+    seen: list = []
+    kw = dict(service_model=const_service,
+              fault_plan=DispatchFaultPlan(seed=2, error_rate=0.3),
+              retries=2, sentry=True)
+    hooked = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7),
+        admission=AdmissionPolicy(max_depth=10, on_alert=seen.append),
+        **kw)
+    plain = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7),
+        admission=AdmissionPolicy(max_depth=10), **kw)
+    # the hook saw EVERY alert, in order — and changed nothing
+    assert seen == hooked.sentry.alerts and seen
+    assert hooked.log_lines() == plain.log_lines()
+    with pytest.raises(ValueError, match="on_alert"):
+        AdmissionPolicy(on_alert=42)
+
+
+def test_queue_stop_resume_alert_log_byte_equal(market, tmp_path):
+    """In-process half of the kill/resume differential: sentry state
+    rides the checkpoint, so the resumed run's alert log and detector
+    state are BYTE-equal to an uninterrupted run's."""
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(12)]
+    arrivals = bursty_arrivals(12, rate_hz=1.2 * LADDER[-1] / SERVICE,
+                               burst=5, seed=11)
+    kw = dict(admission=AdmissionPolicy(max_depth=10),
+              service_model=const_service,
+              fault_plan=DispatchFaultPlan(seed=2, error_rate=0.3),
+              retries=2, sentry=True)
+    straight = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7), **kw)
+    ck = tmp_path / "queue.ckpt"
+    partial = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7),
+        checkpoint_path=ck, _stop_after_dispatches=1, **kw)
+    assert len(partial.verdicts) < 12 and ck.exists()
+    resumed = server.serve_queued(
+        make_requests(cfgs, arrivals, deadline_s=0.7),
+        checkpoint_path=ck, **kw)
+    assert resumed.log_lines() == straight.log_lines()
+    # the ALERT LOG is byte-equal; full state matches once the resumed
+    # side's incident checkpoint refs (which name ck) are nulled
+    assert (json.dumps(resumed.sentry.alerts, sort_keys=True)
+            == json.dumps(straight.sentry.alerts, sort_keys=True))
+    assert no_ckpt_state(resumed.sentry) == no_ckpt_state(straight.sentry)
+    assert straight.sentry.alerts  # the differential is non-vacuous
+    assert all(i["checkpoint"].startswith(str(ck))
+               for i in resumed.sentry.incidents)
+
+
+def test_sigkill_resume_alert_log_crosses_the_boundary(market, tmp_path):
+    """The out-of-process half: a server SIGKILL'd mid-drain leaves its
+    sentry state in the snapshot; the resumed process finishes the
+    drain byte-equal, and the incident CLI triages the combined report
+    across the boundary."""
+    market_path = tmp_path / "market.npz"
+    np.savez(market_path, **{k: np.asarray(v) for k, v in market.items()})
+    ck = tmp_path / "queue.ckpt"
+    script = f"""
+import sys
+sys.path.insert(0, {str(REPO)!r})
+import os
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # match conftest: the
+# checkpoint's config-trace fingerprint hashes the NORMALIZED config
+# leaves, whose dtype follows x64
+import numpy as np
+from factormodeling_tpu.resil import DispatchFaultPlan
+from factormodeling_tpu.serve import TenantConfig, TenantServer
+from factormodeling_tpu.serve.queue import make_requests
+market = np.load({str(market_path)!r}, allow_pickle=False)
+server = TenantServer(names={NAMES!r}, pad_ladder={LADDER!r},
+                      **{{k: market[k] for k in market.files}})
+cfgs = [TenantConfig(top_k=1 + i % {F}, icir_threshold=-1.0,
+                     method="equal", window={WINDOW}) for i in range(8)]
+server.serve_queued(make_requests(cfgs, np.arange(8.0) * 0.2,
+                                  deadline_s=30.0),
+                    service_model=lambda _t, _r: {SERVICE},
+                    fault_plan=DispatchFaultPlan(seed=2, error_rate=0.4),
+                    checkpoint_path={str(ck)!r}, lineage=True, sentry=True)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=420, env={**__import__("os").environ,
+                          "_FMT_SERVE_DIE_AFTER_DISPATCH": "0"})
+    assert proc.returncode == 137, proc.stderr[-2000:]
+    assert ck.exists()
+
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(8)]
+    reqs = lambda: make_requests(cfgs, np.arange(8.0) * 0.2,
+                                 deadline_s=30.0)
+    kw = dict(service_model=const_service,
+              fault_plan=DispatchFaultPlan(seed=2, error_rate=0.4),
+              lineage=True, sentry=True)
+    rep = obs.RunReport("sigkill-sentry")
+    with rep.activate():
+        resumed = server.serve_queued(reqs(), checkpoint_path=ck, **kw)
+    straight = server.serve_queued(reqs(), **kw)
+    assert resumed.log_lines() == straight.log_lines()
+    # pre-kill alerts came from ANOTHER process: byte-equality of the
+    # alert log is the cross-process determinism pin (incident
+    # checkpoint refs are the checkpointed side's resume pointer)
+    assert (json.dumps(resumed.sentry.alerts, sort_keys=True)
+            == json.dumps(straight.sentry.alerts, sort_keys=True))
+    assert no_ckpt_state(resumed.sentry) == no_ckpt_state(straight.sentry)
+    assert resumed.sentry.alerts
+    report = tmp_path / "resumed.jsonl"
+    rep.write_jsonl(report)
+    render = run_cli(INCIDENT_CLI, str(report))
+    assert render.returncode == 0, render.stderr[-2000:]
+    strict = run_cli(INCIDENT_CLI, str(report), "--strict")
+    assert strict.returncode == 0, strict.stderr[-2000:]
+
+
+def test_default_queue_path_elides_the_sentry_module(market, tmp_path):
+    """PR 7-style unimportable pin: with ``obs.sentry`` BLOCKED from
+    importing, the default drain (``sentry=None``) still serves — books
+    bit-identical to a sentry-ON run. The judgment loop is pure opt-in
+    bookkeeping the hot path never touches."""
+    server = mk_server(market)
+    cfgs = [equal_cfg(i) for i in range(3)]
+    res = server.serve_queued(
+        make_requests(cfgs, np.arange(3.0) * 0.2, deadline_s=30.0),
+        service_model=const_service, sentry=True)
+    want = np.nan_to_num(np.asarray(res.outputs[2].sim.weights))
+    market_path = tmp_path / "market.npz"
+    weights_path = tmp_path / "weights.npy"
+    np.savez(market_path, **{k: np.asarray(v) for k, v in market.items()})
+    script = f"""
+import sys
+sys.path.insert(0, {str(REPO)!r})
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name == "factormodeling_tpu.obs.sentry":
+            raise ImportError(f"{{name}} is blocked for the elision pin")
+        return None
+sys.meta_path.insert(0, _Block())
+import os
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from factormodeling_tpu.serve import TenantConfig, TenantServer
+from factormodeling_tpu.serve.queue import make_requests
+market = np.load({str(market_path)!r}, allow_pickle=False)
+server = TenantServer(names={NAMES!r}, pad_ladder={LADDER!r},
+                      **{{k: market[k] for k in market.files}})
+cfgs = [TenantConfig(top_k=1 + i % {F}, icir_threshold=-1.0,
+                     method="equal", window={WINDOW}) for i in range(3)]
+res = server.serve_queued(make_requests(cfgs, np.arange(3.0) * 0.2,
+                                        deadline_s=30.0),
+                          service_model=lambda _t, _r: {SERVICE})
+assert "factormodeling_tpu.obs.sentry" not in sys.modules
+assert res.sentry is None
+np.save({str(weights_path)!r},
+        np.nan_to_num(np.asarray(res.outputs[2].sim.weights)))
+print("ELISION_OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ELISION_OK" in proc.stdout
+    np.testing.assert_array_equal(np.load(weights_path), want)
+
+
+# ------------------------------------- tick-boundary series (advance_all)
+
+
+def test_advance_all_samples_the_health_series(market):
+    """Round-21 satellite: the online tick boundary now feeds the same
+    health ring the queue samples — one sample per ``advance_all`` on
+    the ordinal axis, exact maxima preserved."""
+    import jax.numpy as jnp
+
+    server = mk_server(market)
+    server.online_begin([equal_cfg(i) for i in range(3)])
+    series = HealthSeries()
+    for t in range(4):
+        server.advance_all(
+            _DateSlice(factors=jnp.asarray(market["factors"][:, t, :]),
+                       returns=jnp.asarray(market["returns"][t]),
+                       factor_ret=jnp.asarray(market["factor_ret"][t]),
+                       cap_flag=jnp.asarray(market["cap_flag"][t]),
+                       investability=jnp.asarray(
+                           market["investability"][t]),
+                       universe=jnp.asarray(market["universe"][t])),
+            date=t, series=series)
+    assert series.count == 4
+    ts = [s[0] for s in series.samples]
+    assert ts == [0.0, 1.0, 2.0, 3.0]  # the tick IS the clock
+    assert series.max_depth == len(server._online)
+    assert 0.0 < series.max_occupancy <= 1.0
+    row = series.row("online/advance")
+    assert row["kind"] == "series" and row["count"] == 4
+
+
+def _DateSlice(**kw):
+    from factormodeling_tpu.online.state import DateSlice
+    return DateSlice(**kw)
+
+
+# ------------------------------------------------------------- the gating
+
+
+def _summary(name="q", fired=0, inc=0):
+    return {"kind": "alert", "name": name, "summary": True,
+            "alerts_fired": fired, "incidents": inc, "evals": 5,
+            "detectors": []}
+
+
+def _firing(name="q", aid="a0", signal="retry_rate"):
+    return {"kind": "alert", "name": name, "alert_id": aid, "t_s": 0.1,
+            "detector": "burn_rate", "signal": signal, "window": "1s/6s",
+            "threshold": 1.0, "budget": 0.0, "value": 0.2, "detail": "d"}
+
+
+def test_regression_gates_the_alert_log_both_ways():
+    clean = [_summary()]
+    firing = [_summary(fired=1, inc=1), _firing(),
+              {"kind": "incident", "name": "q", "incident_id": "inc0",
+               "t_s": 0.1, "alert_ids": ["a0"], "trace_ids": [],
+               "output_ids": [], "tenants": ["t0"], "metering_delta": {},
+               "checkpoint": None, "detector_state": []}]
+    assert regression.diff_reports(clean, clean, check_wall=False).ok
+    assert regression.diff_reports(firing, firing, check_wall=False).ok
+    # a NEW firing detector under the same traffic is the regression
+    # the sentry exists to catch
+    res = regression.diff_reports(clean, firing, check_wall=False)
+    assert not res.ok
+    assert any("began firing" in f.detail for f in res.regressions)
+    # ...and a VANISHED one is a disarmed sentry (gates both ways)
+    res = regression.diff_reports(firing, clean, check_wall=False)
+    assert not res.ok
+    assert any("disarmed or log truncated" in f.detail
+               for f in res.regressions)
+    # losing the scope entirely silently un-audits the run
+    res = regression.diff_reports(clean, [], check_wall=False)
+    assert any("lost its operations sentry" in f.detail
+               for f in res.regressions)
+    # a new scope is a re-baseline note, not a regression
+    res = regression.diff_reports([], clean, check_wall=False)
+    assert not any(f.regression and f.section == "alert"
+                   for f in res.findings)
+    assert any("re-baseline" in f.detail for f in res.findings)
+    # the views the gate reads
+    assert regression.fired_alerts(firing) == {
+        "q": {"burn_rate(retry_rate)": 1}}
+    assert regression.incident_rows(firing) == {"q": 1}
+    assert set(regression.alert_rows(firing)) == {"q"}
+
+
+def test_incident_cli_strict_rejects_a_dangling_reference(faulty_report,
+                                                          tmp_path):
+    path, _ = faulty_report
+    render = run_cli(INCIDENT_CLI, str(path))
+    assert render.returncode == 0, render.stderr[-2000:]
+    assert "inc0" in render.stdout
+    strict = run_cli(INCIDENT_CLI, str(path), "--strict")
+    assert strict.returncode == 0, strict.stderr[-2000:]
+    tr = run_cli(TRACE_CLI, str(path), "--strict")
+    assert tr.returncode == 0, tr.stderr[-2000:]
+    assert "operations sentry" in tr.stdout
+    assert "incident bundles" in tr.stdout
+    # ONE dangling reference: both strict tools exit 1 naming it
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    for r in rows:
+        if r.get("kind") == "incident":
+            r["alert_ids"] = ["a99"]
+            break
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    strict = run_cli(INCIDENT_CLI, str(bad), "--strict")
+    assert strict.returncode == 1 and "a99" in strict.stderr
+    tr = run_cli(TRACE_CLI, str(bad), "--strict")
+    assert tr.returncode == 1 and "a99" in tr.stderr
+    # a report with no sentry rows at all is unusable input, not clean
+    rows = [r for r in rows if r.get("kind") not in ("alert", "incident")]
+    none = tmp_path / "none.jsonl"
+    none.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert run_cli(INCIDENT_CLI, str(none)).returncode == 2

@@ -65,6 +65,17 @@ Round 20: serving and online cells run with the provenance ledger on
 edge's input ids resolve, chains are acyclic (``lineage_intact`` in the
 verdict); a fault-injected or killed-and-resumed cell must never record
 a dangling derivation.
+Round 21: serving and online cells run with the OPERATIONS SENTRY on
+(``obs.sentry``) and assert the detection contract both ways: every
+fault-injected cell fires at least one alert attributed to a symptom of
+its own fault class (``SERVING_SENTRY`` / ``ONLINE_SENTRY`` — retry and
+failure burn rates for dispatch faults, reject/replay burns and CUSUM
+drift on the guard gauges for feed anomalies), every clean cell fires
+ZERO alerts (the false-positive half), and every auto-captured incident
+bundle is complete — its cited alert ids, trace ids and lineage output
+ids all resolve within the cell's rows (``sentry_clean`` /
+``alerts_fired`` / ``incidents`` in the verdict; ``tools/incident.py``
+renders the bundles from the ``--report`` artifact).
 
 ``--scenarios`` switches to the round-16 SCENARIO preset
 (``factormodeling_tpu.scenarios``, architecture.md §22): each cell runs a
@@ -383,6 +394,48 @@ def run_chaos(*, shape=(6, 48, 16), window: int = 8,
 SERVING_FAULTS = ("none", "dispatch_error", "dispatch_poison",
                   "dispatch_flaky")
 
+#: round 21 — the sentry attribution table: per fault class, the signals
+#: at least one of which MUST fire (expected) and the full set that MAY
+#: fire (allowed). ``dispatch_error`` raises before dispatching, so its
+#: primary symptom is the retry burn (failures only when retries
+#: exhaust); poison/flaky dispatches both retry and fail. Clean cells
+#: must fire NOTHING — the zero-false-positive half of the contract (the
+#: default detectors arm only zero-budget failure/retry burns, which a
+#: legitimately-overloaded clean drain never trips: overload sheds, it
+#: does not fail).
+SERVING_SENTRY = {
+    "none": (frozenset(), frozenset()),
+    "dispatch_error": (frozenset({"retry_rate"}),
+                       frozenset({"retry_rate", "failure_rate"})),
+    "dispatch_poison": (frozenset({"retry_rate", "failure_rate"}),
+                        frozenset({"retry_rate", "failure_rate"})),
+    "dispatch_flaky": (frozenset({"retry_rate", "failure_rate"}),
+                       frozenset({"retry_rate", "failure_rate"})),
+}
+
+
+def _sentry_violations(fired, expected, allowed, cell: str) -> list:
+    """The attribution judgment shared by both presets: a fault cell
+    must fire (missed detection), at least one fired signal must be an
+    expected symptom of the injected fault (misattribution), and nothing
+    outside the allowed set may fire (false positive)."""
+    fired = set(fired)
+    if not expected:
+        return ([f"sentry false positive(s) with no fault injected: "
+                 f"{sorted(fired)}"] if fired else [])
+    out = []
+    if not fired:
+        out.append(f"sentry fired no alert for injected fault ({cell})")
+    else:
+        if not fired & expected:
+            out.append(f"sentry misattribution: fired {sorted(fired)}, "
+                       f"expected one of {sorted(expected)}")
+        extra = fired - allowed
+        if extra:
+            out.append(f"sentry fired outside the allowed set: "
+                       f"{sorted(extra)} (allowed {sorted(allowed)})")
+    return out
+
 #: admission policies of the serving matrix: "open" = unbounded (the
 #: collapse baseline — it must still verdict everything), "bounded" =
 #: depth-capped pure shedding, "degrade" = the full ladder
@@ -391,9 +444,13 @@ SERVING_POLICIES = ("open", "bounded", "degrade")
 
 
 def _serving_fault_plan(resil, kind: str, seed: int):
+    # rates sized so the default grid's seeded plans actually roll >= 1
+    # fault per cell (the round-21 sentry detection half judges a cell
+    # only against faults that OCCURRED, but a grid whose cells roll
+    # nothing would prove nothing — 0.3 poison over 3 dispatches missed)
     rates = {"none": None,
              "dispatch_error": dict(error_rate=0.3),
-             "dispatch_poison": dict(poison_rate=0.3),
+             "dispatch_poison": dict(poison_rate=0.6),
              "dispatch_flaky": dict(error_rate=0.2, poison_rate=0.2)}[kind]
     return None if rates is None else resil.DispatchFaultPlan(seed=seed,
                                                               **rates)
@@ -484,7 +541,8 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                 service_model=lambda _tag, _rung: service_s,
                 fault_plan=_serving_fault_plan(resil, fault, seed + idx),
                 retries=2, checkpoint_path=cell_ck,
-                queue_name=f"chaos/{cell}", flight=True, lineage=True)
+                queue_name=f"chaos/{cell}", flight=True, lineage=True,
+                sentry=True)
 
             c = res.counters
             violations: list[str] = []
@@ -513,6 +571,29 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                 res.lineage.rows(f"chaos/{cell}"))
             if lin_errs:
                 violations.extend(lin_errs[:4])
+            # round 21: the sentry's verdict — every fault cell fires at
+            # least one alert attributed to a symptom of ITS fault class,
+            # clean cells fire zero (SERVING_SENTRY docs), and every
+            # auto-captured incident bundle is complete: cited alert,
+            # trace and lineage-output ids all resolve within the cell's
+            # own rows
+            from factormodeling_tpu.obs import sentry as obs_sentry
+
+            fired = set(res.sentry.fired_signals())
+            expected, allowed = SERVING_SENTRY[fault]
+            if fault != "none" and not c["dispatch_faults"]:
+                # the seeded plan rolled zero faults in this cell (small
+                # grids at adverse seeds): detection is vacuous, but the
+                # false-positive half still applies
+                expected = frozenset()
+            sentry_violations = _sentry_violations(fired, expected,
+                                                  allowed, cell)
+            sentry_rows = res.sentry.rows(f"chaos/{cell}")
+            s_errs = obs_sentry.sentry_errors(
+                sentry_rows + res.flight.recorder.rows(f"chaos/{cell}")
+                + res.lineage.rows(f"chaos/{cell}"))
+            sentry_violations.extend(s_errs[:4])
+            violations.extend(sentry_violations)
             by_rid = res.by_rid()
             if sorted(by_rid) != list(range(n_requests)):
                 violations.append("verdict completeness: not every rid "
@@ -552,6 +633,10 @@ def run_serving_chaos(*, shape=(5, 30, 10), window: int = 6,
                       "trace_complete": bool(trace_complete),
                       "metering_conserved": not conserve,
                       "lineage_intact": not lin_errs,
+                      "sentry_clean": not sentry_violations,
+                      "alerts_fired": sorted(fired),
+                      "incidents": sum(1 for r in sentry_rows
+                                       if r.get("kind") == "incident"),
                       **{k: int(c[k]) for k in
                          ("submitted", "served", "shed_count",
                           "deadline_miss_count", "failed_count",
@@ -742,6 +827,41 @@ ONLINE_EXPECT = {
     ("kill_after_apply", "guarded"): ("rejected", "duplicate"),
 }
 
+#: round 21 — the online sentry attribution table (same shape as
+#: SERVING_SENTRY): every cell arms zero-budget reject/replay burns plus
+#: CUSUM drift on the guard gauges (``nan_frac`` / ``universe_count``).
+#: An OPEN engine applies the poisoned slice, so the DRIFT detector is
+#: the one that must catch it; a GUARDED engine rejects it, so the
+#: reject burn fires (the drift detector may also trip — the rejected
+#: slice's gauges are still observed — hence the wider allowed set).
+ONLINE_SENTRY = {
+    ("late_date", "open"): (frozenset({"reject_rate"}),
+                            frozenset({"reject_rate"})),
+    ("late_date", "guarded"): (frozenset({"reject_rate"}),
+                               frozenset({"reject_rate"})),
+    ("duplicate_date", "open"): (frozenset({"reject_rate"}),
+                                 frozenset({"reject_rate"})),
+    ("duplicate_date", "guarded"): (frozenset({"reject_rate"}),
+                                    frozenset({"reject_rate"})),
+    ("restated_date", "open"): (frozenset({"replay_rate"}),
+                                frozenset({"replay_rate"})),
+    ("restated_date", "guarded"): (frozenset({"replay_rate"}),
+                                   frozenset({"replay_rate"})),
+    ("nan_storm", "open"): (frozenset({"nan_frac"}),
+                            frozenset({"nan_frac"})),
+    ("nan_storm", "guarded"): (frozenset({"reject_rate"}),
+                               frozenset({"reject_rate", "nan_frac"})),
+    ("universe_collapse", "open"): (frozenset({"universe_count"}),
+                                    frozenset({"universe_count"})),
+    ("universe_collapse", "guarded"): (
+        frozenset({"reject_rate"}),
+        frozenset({"reject_rate", "universe_count"})),
+    ("kill_after_apply", "open"): (frozenset({"reject_rate"}),
+                                   frozenset({"reject_rate"})),
+    ("kill_after_apply", "guarded"): (frozenset({"reject_rate"}),
+                                      frozenset({"reject_rate"})),
+}
+
 
 def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                      method: str = "equal", faults=None, policies=None,
@@ -894,13 +1014,29 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                            if is_kill else None)
 
                 def make_engine():
+                    # round 21: the cell's sentry — zero-budget burns on
+                    # the reject/replay verdicts plus CUSUM drift on the
+                    # guard gauges (ONLINE_SENTRY docs). Built fresh per
+                    # engine; a kill cell's restarted engine restores its
+                    # detector state from the checkpoint seam, so the
+                    # resumed alert log continues the killed one
+                    from factormodeling_tpu.obs.sentry import (
+                        BurnRateDetector, CusumDetector, Sentry)
+
                     return OnlineEngine(
                         names=names, n_assets=n, template=template,
                         has_universe=True, horizon=6,
                         guards=guards[pol_name], checkpoint=ck_file,
                         retain_history=True, dtype=np.float32,
                         progress=lambda msg: progress(f"{cell}: {msg}"),
-                        flight=True, lineage=True)
+                        flight=True, lineage=True,
+                        sentry=Sentry(detectors=[
+                            BurnRateDetector("reject_rate", bad="rejected",
+                                             total="ingested", budget=0.0),
+                            BurnRateDetector("replay_rate", bad="replayed",
+                                             total="ingested", budget=0.0),
+                            CusumDetector("nan_frac"),
+                            CusumDetector("universe_count")]))
 
                 eng = make_engine()
                 # the recorder is per-process: the final engine's trace
@@ -991,6 +1127,21 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                 lin_errs = obs_lineage.ledger_errors(lin_rows)
                 if lin_errs:
                     violations.extend(lin_errs[:4])
+                # round 21: the sentry's verdict — the anomaly must fire
+                # the signal attributed to ITS class (ONLINE_SENTRY), the
+                # clean prefix must fire nothing extra, and every
+                # incident bundle resolves (engine incidents cite lineage
+                # output ids, never per-process trace ids)
+                from factormodeling_tpu.obs import sentry as obs_sentry
+
+                fired = set(eng._sentry.fired_signals())
+                expected, allowed = ONLINE_SENTRY[(anomaly, pol_name)]
+                sentry_violations = _sentry_violations(fired, expected,
+                                                      allowed, cell)
+                sentry_rows = eng.sentry_rows(f"chaos/{cell}/sentry")
+                s_errs = obs_sentry.sentry_errors(sentry_rows + lin_rows)
+                sentry_violations.extend(s_errs[:4])
+                violations.extend(sentry_violations)
                 # statuses derive from the engine's GLOBAL counters, not
                 # the verdicts this process saw: a killed-and-resumed
                 # cell's stdout must be byte-equal to a straight-through
@@ -1004,6 +1155,10 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                     "trace_complete": bool(trace_complete),
                     "metering_conserved": not meter_errors,
                     "lineage_intact": not lin_errs,
+                    "sentry_clean": not sentry_violations,
+                    "alerts_fired": sorted(fired),
+                    "incidents": sum(1 for r in sentry_rows
+                                     if r.get("kind") == "incident"),
                     "statuses": statuses,
                     "counters": {k: int(v)
                                  for k, v in sorted(eng.counters.items())},
@@ -1021,6 +1176,7 @@ def run_online_chaos(*, shape=(6, 48, 16), window: int = 8,
                            **eng.report_fields())
                 rep.rows.extend(eng.flight_rows(f"chaos/{cell}/trace"))
                 rep.rows.extend(lin_rows)
+                rep.rows.extend(sentry_rows)
                 progress(f"{cell}: "
                          f"{'ok' if result['ok'] else 'FAIL'} "
                          f"(statuses={statuses})")

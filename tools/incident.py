@@ -1,0 +1,232 @@
+"""Render and verify the sentry's alert log and incident bundles in a
+RunReport JSONL.
+
+Usage::
+
+    python tools/incident.py report.jsonl [--name NAME] [--strict]
+        [--checkpoints]
+
+Default mode prints the triage story: every sentry scope's summary (how
+many evaluations, which detectors were armed, how many alerts fired),
+each firing alert with its attribution (detector, signal, window,
+threshold, value), and each incident bundle — the cited alerts, the
+implicated trace/output ids and tenants, the per-tenant metering delta
+of the alarm window, and the checkpoint reference a responder would
+resume from.
+
+``--strict`` verifies the artifact-checkable completeness invariant
+(docs/architecture.md §27): every firing alert names its detector,
+signal, window and threshold; every summary row's counts match the rows
+present; every incident's cited alert ids, trace ids and output ids
+resolve within the same report. With ``--checkpoints``, each incident's
+checkpoint reference (``path`` or ``path@dispatch``) is additionally
+probed on THIS box and a missing file exits 1 — off by default, because
+a report legitimately outlives the scratch checkpoints it names (the
+``tools/lineage.py --artifacts`` honesty rule).
+
+Pure stdlib: the checkers live in ``factormodeling_tpu/obs/sentry.py``
+(itself stdlib-only) and are loaded standalone by file path — the same
+contract as ``tools/lineage.py`` / ``tools/report_diff.py``, so this
+tool runs anywhere the JSONL does.
+
+Exit codes: 0 = clean; 1 = completeness/integrity violation (each named
+on stderr); 2 = unusable input (missing/empty report, or no sentry rows
+at all — was the run recorded with the sentry on?).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+_SENTRY_PATH = (Path(__file__).resolve().parent.parent
+                / "factormodeling_tpu" / "obs" / "sentry.py")
+
+
+def _load_sentry():
+    """Import obs/sentry.py WITHOUT the package __init__ (which pulls
+    jax). Same sys.modules key and cache-first semantics as the other
+    standalone tools — one process, one module identity."""
+    name = "_fmt_obs_sentry"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, _SENTRY_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(name, None)  # never cache a half-initialized module
+        raise
+    return mod
+
+
+def load_rows(path) -> list:
+    """Rows of a RunReport JSONL; corrupt tail lines are skipped with a
+    warning (a killed run's last line must not hide the rest)."""
+    rows = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"incident: {path}:{lineno}: skipping corrupt line",
+                      file=sys.stderr)
+    return rows
+
+
+def checkpoint_errors(rows) -> list:
+    """On-disk resolution of each incident's checkpoint reference
+    (``--checkpoints``): the ``path`` of a ``path@dispatch`` ref must
+    exist on this box."""
+    errs = []
+    for r in rows:
+        if r.get("kind") != "incident":
+            continue
+        ref = r.get("checkpoint")
+        if not ref:
+            continue
+        path = str(ref).rsplit("@", 1)[0]
+        if not Path(path).exists():
+            errs.append(
+                f"incident {r.get('name', '?')}/"
+                f"{r.get('incident_id', '?')}: checkpoint ref {ref!r} "
+                f"does not resolve — no file at {path!r}")
+    return errs
+
+
+def _fmt_costs(costs: dict) -> str:
+    return ", ".join(f"{k}={v:g}" for k, v in sorted(costs.items())) \
+        or "none"
+
+
+def render_lines(rows, *, name=None) -> list:
+    """The triage story, one scope at a time."""
+    lines = []
+    scopes: dict = {}
+    for r in rows:
+        if r.get("kind") not in ("alert", "incident"):
+            continue
+        if name is not None and r.get("name") != name:
+            continue
+        scopes.setdefault(r.get("name", "?"), []).append(r)
+    for scope in scopes:
+        rws = scopes[scope]
+        summary = next((r for r in rws if r.get("kind") == "alert"
+                        and r.get("summary")), None)
+        lines.append(f"sentry {scope}:")
+        if summary is not None:
+            dets = summary.get("detectors") or []
+            armed = ", ".join(
+                f"{d.get('detector', '?')}({d.get('signal', '?')})"
+                for d in dets) or "none"
+            lines.append(f"  {summary.get('evals', 0)} evaluation(s), "
+                         f"{summary.get('alerts_fired', 0)} alert(s), "
+                         f"{summary.get('incidents', 0)} incident(s); "
+                         f"armed: {armed}")
+        for r in rws:
+            if r.get("kind") != "alert" or r.get("summary"):
+                continue
+            tenant = f" tenant={r['tenant']}" if r.get("tenant") else ""
+            lines.append(
+                f"  ALERT {r.get('alert_id', '?')} t={r.get('t_s')}: "
+                f"{r.get('detector', '?')}({r.get('signal', '?')}) "
+                f"window={r.get('window', '?')} "
+                f"threshold={r.get('threshold', '?')} "
+                f"value={r.get('value', '?')}{tenant}"
+                + (f" — {r['detail']}" if r.get("detail") else ""))
+        for r in rws:
+            if r.get("kind") != "incident":
+                continue
+            lines.append(
+                f"  INCIDENT {r.get('incident_id', '?')} "
+                f"t={r.get('t_s')}: alerts="
+                f"{','.join(r.get('alert_ids') or []) or 'none'}")
+            if r.get("trace_ids"):
+                lines.append(f"    traces: "
+                             f"{', '.join(map(str, r['trace_ids']))}")
+            if r.get("output_ids"):
+                lines.append(f"    outputs: "
+                             f"{', '.join(map(str, r['output_ids']))}")
+            if r.get("tenants"):
+                lines.append(f"    tenants: "
+                             f"{', '.join(map(str, r['tenants']))}")
+            for tn, costs in sorted(
+                    (r.get("metering_delta") or {}).items()):
+                lines.append(f"    bill[{tn}]: {_fmt_costs(costs)}")
+            if r.get("checkpoint"):
+                lines.append(f"    checkpoint: {r['checkpoint']}")
+    return lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("report", help="RunReport JSONL with "
+                                       "kind=\"alert\"/\"incident\" rows")
+    parser.add_argument("--name", default=None,
+                        help="restrict to one sentry scope "
+                             "(e.g. serve/queue)")
+    parser.add_argument("--strict", action="store_true",
+                        help="verify the completeness invariant instead "
+                             "of rendering")
+    parser.add_argument("--checkpoints", action="store_true",
+                        help="strict: also require each incident's "
+                             "checkpoint ref to resolve on this box")
+    args = parser.parse_args(argv)
+
+    sn = _load_sentry()
+    try:
+        rows = load_rows(args.report)
+    except OSError as e:
+        print(f"incident: cannot read report {args.report!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if not rows:
+        print(f"incident: report {args.report!r} has no parseable rows",
+              file=sys.stderr)
+        return 2
+    srows = [r for r in rows if r.get("kind") in ("alert", "incident")
+             and (args.name is None or r.get("name") == args.name)]
+    if not srows:
+        print(f"incident: report {args.report!r} has no alert/incident "
+              f"rows" + (f" for name={args.name}" if args.name else "")
+              + " — was the run recorded with the sentry on?",
+              file=sys.stderr)
+        return 2
+
+    if not args.strict:
+        for line in render_lines(rows, name=args.name):
+            print(line)
+        return 0
+
+    # strict: id resolution runs over the WHOLE report (trace/output ids
+    # live under other names), completeness over the selected scope
+    scoped = ([r for r in rows if r.get("kind") not in
+               ("alert", "incident") or r.get("name") == args.name]
+              if args.name is not None else rows)
+    errs = list(sn.sentry_errors(scoped))
+    if args.checkpoints:
+        errs.extend(checkpoint_errors(srows))
+    if errs:
+        for e in errs:
+            print(f"incident: {e}", file=sys.stderr)
+        print(f"incident: {len(errs)} completeness error(s) in "
+              f"{args.report}", file=sys.stderr)
+        return 1
+    n_alerts = sum(1 for r in srows if r.get("kind") == "alert"
+                   and not r.get("summary"))
+    n_inc = sum(1 for r in srows if r.get("kind") == "incident")
+    print(f"incident: OK — {n_alerts} alert(s), {n_inc} incident(s), "
+          f"completeness verified"
+          + (" (+ checkpoint refs resolved)" if args.checkpoints else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

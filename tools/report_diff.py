@@ -47,7 +47,12 @@ or a provenance ledger / arrival trace present in the baseline but
 missing from the new report (``kind="lineage"`` / ``kind="traffic"``
 rows, round 20 — a run must never silently lose its audit trail; edge
 CONTENTS are content-addressed and legitimately change with the data,
-so only per-name presence gates) —
+so only per-name presence gates), or a sentry alert that began firing —
+or stopped firing, or vanished with its scope — against the same
+recorded traffic (``kind="alert"`` / ``kind="incident"`` rows, round 21
+— armed under ``--no-wall``: the alert log is deterministic on the
+virtual clock, so a new firing is an operational regression and a
+vanished one is a disarmed sentry, never machine speed) —
 all exit 1 with a one-line attribution. Reports with mismatched
 ``kind="meta"`` schema versions REFUSE to gate; cross-backend pairs warn
 and skip wall gating automatically; differing ``code_fingerprint``

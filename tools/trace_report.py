@@ -15,7 +15,9 @@ ingestions, plus rejection reasons and the full-recompute fallback
 tally), the round-20 provenance ledger (``kind="lineage"`` edge counts
 per ledger name, by edge kind, with superseding-restatement tallies)
 and recorded traffic (``kind="traffic"`` arrival traces per queue, by
-verdict), device-time
+verdict), the round-21 operations sentry (``kind="alert"`` summaries
+and firing alerts per scope, ``kind="incident"`` auto-captured bundles
+with their cited traces/outputs/checkpoint), device-time
 attribution, cost-analysis estimates, bench rows, and plain stage
 records print in their own sections. Pure stdlib — usable on any box that has the JSONL, no jax
 required.
@@ -44,7 +46,11 @@ the measured dispatch totals), and round-20 provenance violations (a
 ``kind="lineage"`` edge referencing an input id no recorded edge
 produced — a dangling reference or cycle — or a ``kind="traffic"`` row
 whose verdict does not reconcile with the queue's ``kind="serving"``
-summary counters) into 1);
+summary counters), and round-21 sentry violations (a firing alert
+missing its detector/signal/window/threshold attribution, a summary
+whose counts disagree with the rows present, or an incident bundle
+citing an alert, trace or lineage-output id that does not resolve
+within the report) into 1);
 2 = unusable input (missing/unreadable file, no parseable rows at all
 — empty or fully corrupt — or ``--timeline`` on a report with no
 traces). A truncated tail — a run killed mid-write — is
@@ -117,6 +123,18 @@ def _lineage_mod():
     try:
         return _load_standalone("_fmt_obs_lineage",
                                 _REG_PATH.parent / "lineage.py")
+    except OSError:
+        return None
+
+
+def _sentry_mod():
+    """obs/sentry.py loaded standalone (stdlib-only by contract) — the
+    round-21 sentry completeness checkers, under the same sys.modules
+    key as tools/incident.py. None when the package file is not next to
+    this tool — sentry strict checks then skip with a warning."""
+    try:
+        return _load_standalone("_fmt_obs_sentry",
+                                _REG_PATH.parent / "sentry.py")
     except OSError:
         return None
 
@@ -688,6 +706,57 @@ def _series_table(rows) -> str | None:
                           "max_occupancy", "last sample"), body))
 
 
+def _alert_table(rows) -> str | None:
+    al = [r for r in rows if r.get("kind") == "alert"]
+    if not al:
+        return None
+    last: dict[str, dict] = {}
+    for r in al:
+        if r.get("summary"):
+            last[str(r.get("name", "?"))] = r
+    body = []
+    for name, r in sorted(last.items()):
+        dets = r.get("detectors") or []
+        body.append((name, r.get("evals", "-"), len(dets),
+                     r.get("alerts_fired", "-"), r.get("incidents", "-")))
+    out = ("== operations sentry (virtual-clock detectors; zero fired "
+           "alerts is itself evidence) ==\n"
+           + _fmt_table(("sentry", "evals", "armed", "alerts_fired",
+                         "incidents"), body))
+    firing = [r for r in al if not r.get("summary")]
+    if firing:
+        fbody = [(r.get("name", "?"), r.get("alert_id", "?"),
+                  f"{r.get('detector', '?')}({r.get('signal', '?')})",
+                  _num(r.get("t_s", "-")), _num(r.get("value", "-")),
+                  _num(r.get("threshold", "-")), r.get("detail", "-") or "-")
+                 for r in firing]
+        out += ("\n\n== firing alerts (latched detector transitions, "
+                "ordered by virtual time) ==\n"
+                + _fmt_table(("sentry", "alert", "detector(signal)", "t_s",
+                              "value", "threshold", "detail"), fbody))
+    return out
+
+
+def _incident_table(rows) -> str | None:
+    inc = [r for r in rows if r.get("kind") == "incident"]
+    if not inc:
+        return None
+    body = []
+    for r in inc:
+        ck = r.get("checkpoint")
+        body.append((r.get("name", "?"), r.get("incident_id", "?"),
+                     _num(r.get("t_s", "-")),
+                     len(r.get("alert_ids") or ()),
+                     len(r.get("trace_ids") or ()),
+                     len(r.get("output_ids") or ()),
+                     ",".join(r.get("tenants") or ()) or "-",
+                     Path(str(ck)).name if ck else "-"))
+    return ("== incident bundles (auto-captured on alert: cited traces/"
+            "outputs must resolve within this report) ==\n"
+            + _fmt_table(("sentry", "incident", "t_s", "alerts", "traces",
+                          "outputs", "tenants", "checkpoint"), body))
+
+
 def _stage_table(rows) -> str | None:
     stages = [r for r in rows
               if r.get("kind") not in ("span", "counters", "cost", "bench",
@@ -697,7 +766,7 @@ def _stage_table(rows) -> str | None:
                                        "scenario", "online", "meta",
                                        "spec_choice", "reqtrace",
                                        "metering", "series", "lineage",
-                                       "traffic")]
+                                       "traffic", "alert", "incident")]
     if not stages:
         return None
     body = []
@@ -743,8 +812,8 @@ def render(rows) -> str:
     sections = [head]
     for maker in (_span_table, _latency_table, _serving_table,
                   _reqtrace_table, _metering_table, _traffic_table,
-                  _lineage_table, _series_table,
-                  _online_table, _scenario_table, _counter_table, _solver_table,
+                  _lineage_table, _series_table, _alert_table,
+                  _incident_table, _online_table, _scenario_table, _counter_table, _solver_table,
                   _numerics_table, _watchdog_table, _compile_table,
                   _comms_table, _spec_table, _memory_table, _sharding_table,
                   _devtime_table, _cost_table, _bench_table, _stage_table):
@@ -980,6 +1049,25 @@ def lineage_errors(rows) -> list[str]:
     return list(lin.ledger_errors(rows)) + list(lin.traffic_errors(rows))
 
 
+def sentry_strict_errors(rows) -> list[str]:
+    """The round-21 operations-sentry strict checks, judged from the
+    artifact alone: every firing ``kind="alert"`` row must carry its
+    detector/signal attribution, each scope's summary counts must match
+    the rows present, and every ``kind="incident"`` bundle's cited alert
+    ids, trace ids and lineage output ids must resolve within the report
+    (``obs.sentry.sentry_errors``). Skips with a warning when
+    obs/sentry.py is not next to this tool (the copied-alone render
+    box)."""
+    if not any(r.get("kind") in ("alert", "incident") for r in rows):
+        return []
+    sn = _sentry_mod()
+    if sn is None:
+        print("warning: obs/sentry.py not found next to this tool — "
+              "sentry strict checks skipped", file=sys.stderr)
+        return []
+    return list(sn.sentry_errors(rows))
+
+
 def write_timeline(rows, path) -> "str | None":
     """Export the report's ``kind="reqtrace"`` rows as a Chrome-trace/
     Perfetto timeline JSON (``--timeline``); returns the written path,
@@ -1038,9 +1126,11 @@ def main(argv=None) -> int:
                              "serving/scenario row is malformed (incl. "
                              "non-finite VaR/ES), any spec_choice "
                              "row's chosen layout disagrees with the "
-                             "ledger's ranked winner, or any lineage "
+                             "ledger's ranked winner, any lineage "
                              "edge dangles / traffic verdict fails to "
-                             "reconcile — makes the renderer CI-able")
+                             "reconcile, or any sentry alert/incident "
+                             "row is unattributed or cites ids that do "
+                             "not resolve — makes the renderer CI-able")
     args = parser.parse_args(argv)
     try:
         rows = load_rows(args.jsonl)
@@ -1105,6 +1195,13 @@ def main(argv=None) -> int:
                   f"lineage references, cycles, or traffic verdicts that "
                   f"do not reconcile with the serving row): "
                   + "; ".join(ln), file=sys.stderr)
+            rc = 1
+        sv = sentry_strict_errors(rows)
+        if sv:
+            print(f"strict: {len(sv)} sentry violation(s) (unattributed "
+                  f"alerts, summary/row count mismatches, or incident "
+                  f"bundles citing unresolved ids): " + "; ".join(sv),
+                  file=sys.stderr)
             rc = 1
         return rc
     return 0
